@@ -1,0 +1,79 @@
+"""Benchmark: GPT-2 345M training throughput, tokens/sec/chip, bf16.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference publishes no numbers; the operative bar
+is >=0.9x A100-NCCL tokens/sec/chip.  We take 60,000 tokens/s/chip as the
+A100 reference point for GPT-2 345M (Megatron-style measurements at ~40% MFU
+of A100's 312 bf16 TFLOP/s: 0.4*312e12 / (6*345e6 flops/token) ~= 60k) and
+report vs_baseline = ours / 60000.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+A100_TOKENS_PER_SEC = 60000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    on_tpu = jax.default_backend() == "tpu"
+    paddle.seed(0)
+
+    if on_tpu:
+        cfg = GPTConfig.gpt2_medium()
+        batch, seq, steps, warmup = 8, 1024, 12, 3
+    else:  # CPU smoke config so bench.py always runs
+        cfg = GPTConfig.tiny()
+        batch, seq, steps, warmup = 2, 64, 4, 1
+
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, lambda logits, labels: crit(logits, labels), opt)
+
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = jnp.asarray(ids)
+
+    # compile + warmup
+    for _ in range(warmup):
+        loss = step(x, x)
+    loss.numpy()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, x)
+    loss._array.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    metric = ("tokens/sec/chip (GPT-2 345M bf16 train)" if on_tpu
+              else "tokens/sec (GPT-2 tiny, CPU smoke)")
+    result = {
+        "metric": metric,
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / A100_TOKENS_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,215 @@
+"""paddle_tpu.autograd (reference surface: python/paddle/autograd/).
+
+Two layers:
+* eager-tape utilities: ``backward``, ``PyLayer`` (custom autograd node —
+  reference: paddle/fluid/eager/pylayer/, python/paddle/autograd/py_layer.py)
+* functional transforms delegating to jax: ``vjp``, ``jvp``, ``Jacobian``,
+  ``Hessian`` (reference: python/paddle/autograd/functional.py:22,:79,:165)
+  — these run on raw-fn semantics, supporting arbitrary-order composition,
+  which the reference could not do.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call, unwrap
+from ..core.engine import grad, run_backward
+from ..core.grad_mode import no_grad
+from ..core.tensor import GradNode, Tensor
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "vjp", "jvp",
+           "Jacobian", "Hessian", "no_grad"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    run_backward(list(tensors), list(grad_tensors), retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op.
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.exp(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            y, = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        from ..core.grad_mode import is_grad_enabled
+        if diff_inputs and is_grad_enabled():
+            cls_ref = cls
+
+            def vjp_fn(cots):
+                if not isinstance(cots, (tuple, list)):
+                    cots = (cots,)
+                grads = cls_ref.backward(
+                    ctx, *[Tensor(c) for c in cots])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                # backward returns one grad per *tensor* forward input, in
+                # order; pick out the ones for differentiable inputs
+                out = []
+                ti = 0
+                for a in args:
+                    if isinstance(a, Tensor):
+                        if not a.stop_gradient:
+                            g = grads[ti] if ti < len(grads) else None
+                            out.append(g._array if isinstance(g, Tensor) else g)
+                        ti += 1
+                return tuple(out)
+
+            node = GradNode(
+                vjp_fn=vjp_fn,
+                inputs=diff_inputs,
+                out_avals=[(tuple(o._array.shape), o._array.dtype)
+                           for o in outs],
+                name=cls.__name__,
+                out_treedef=jax.tree_util.tree_structure(
+                    tuple(0 for _ in outs)),
+            )
+            for i, o in enumerate(outs):
+                o._grad_node = node
+                o._out_index = i
+                o._stop_gradient = False
+        return out if single else outs
+
+
+# -- functional transforms ---------------------------------------------------
+
+
+def _fn_on_arrays(func):
+    def f(*arrays):
+        res = func(*[Tensor(a) for a in arrays])
+        return unwrap(res)
+    return f
+
+
+def vjp(func, xs, v=None):
+    """reference: python/paddle/autograd/functional.py:22"""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_t]
+    out, pullback = jax.vjp(_fn_on_arrays(func), *arrays)
+    if v is None:
+        v_arr = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_arr = unwrap(v)
+    grads = pullback(v_arr)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    grads_w = [Tensor(g) for g in grads]
+    return wrap(out), grads_w if len(grads_w) > 1 else grads_w[0]
+
+
+def jvp(func, xs, v=None):
+    """reference: python/paddle/autograd/functional.py:79"""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v_t = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [unwrap(t) for t in v_t]
+    out, tangent_out = jax.jvp(_fn_on_arrays(func), tuple(arrays),
+                               tuple(tangents))
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    return wrap(out), wrap(tangent_out)
+
+
+class Jacobian:
+    """reference: python/paddle/autograd/functional.py:165 — lazy full
+    jacobian; here computed via jax.jacrev on first access."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [unwrap(x) for x in self._xs]
+        jac_fn = jax.jacrev(_fn_on_arrays(func),
+                            argnums=tuple(range(len(arrays))))
+        self._jac = jac_fn(*arrays)
+        self._is_batched = is_batched
+
+    def __getitem__(self, idx):
+        j = self._jac
+        if isinstance(j, tuple) and len(j) == 1:
+            j = j[0]
+        arr = j
+        if isinstance(arr, tuple):
+            arr = jnp.concatenate(
+                [a.reshape(a.shape[0], -1) for a in arr], axis=-1)
+        else:
+            arr = arr.reshape(arr.shape[0], -1) if arr.ndim > 2 else arr
+        return Tensor(arr[idx] if idx is not None else arr)
+
+    def numpy(self):
+        return self[slice(None)].numpy()
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [unwrap(x) for x in self._xs]
+        hess_fn = jax.hessian(_fn_on_arrays(func),
+                              argnums=tuple(range(len(arrays))))
+        self._hess = hess_fn(*arrays)
+
+    def __getitem__(self, idx):
+        h = self._hess
+        while isinstance(h, tuple) and len(h) == 1:
+            h = h[0]
+        if isinstance(h, tuple):
+            raise NotImplementedError("multi-input Hessian indexing")
+        n = 1
+        for s in h.shape[:h.ndim // 2]:
+            n *= s
+        arr = h.reshape(n, n)
+        return Tensor(arr[idx] if idx is not None else arr)

@@ -1,0 +1,15 @@
+from . import dtype
+from .dispatch import call, unwrap, wrap_op
+from .engine import grad, run_backward
+from .grad_mode import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .random import (Generator, default_generator, get_rng_state, key_stream,
+                     next_key, seed, set_rng_state)
+from .tensor import Parameter, Tensor, is_tensor, to_tensor
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "is_tensor",
+    "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "grad", "run_backward", "call", "wrap_op", "unwrap",
+    "seed", "Generator", "default_generator", "next_key", "key_stream",
+    "get_rng_state", "set_rng_state", "dtype",
+]

@@ -1,0 +1,158 @@
+"""Eager op dispatch: raw jax fn -> Tensor-level op with tape recording.
+
+This is the TPU-native replacement for the reference's generated per-op
+dygraph functions (reference: paddle/fluid/eager/auto_code_generator/ — each
+op got a generated forward that runs the phi kernel then wires a GradNode).
+Here one generic ``call`` does both: run the raw ``jax.numpy`` computation,
+and when autograd is recording, capture the op's pullback via ``jax.vjp``.
+
+Raw op functions operate purely on jax arrays (so they are also directly
+usable inside ``jit``/``grad`` traces); the Tensor-level wrappers produced by
+``wrap_op`` are what ``paddle_tpu.ops`` exports.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as _dtype_mod
+from .grad_mode import is_grad_enabled
+from .tensor import GradNode, Tensor
+
+_TensorLeaf = lambda x: isinstance(x, Tensor)
+_amp = None  # lazily bound paddle_tpu.amp module
+
+
+def _is_diff(x) -> bool:
+    return (isinstance(x, Tensor) and not x._stop_gradient
+            and _dtype_mod.is_inexact(x._array.dtype))
+
+
+def call(raw_fn: Callable, *args, name: str = None, **kwargs):
+    """Execute ``raw_fn`` over unwrapped args; record a GradNode if needed."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_TensorLeaf)
+
+    diff_idx = []
+    if is_grad_enabled():
+        diff_idx = [i for i, l in enumerate(leaves) if _is_diff(l)]
+
+    arrays = [l._array if isinstance(l, Tensor) else l for l in leaves]
+
+    # AMP: cast fp32 inputs of white-listed ops to the active amp dtype
+    global _amp
+    if _amp is None:
+        from .. import amp as _amp_mod
+        _amp = _amp_mod
+    if _amp.amp_state()["enable"]:
+        arrays = _amp.amp_cast_inputs(name, arrays)
+
+    if not diff_idx:
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
+        out = raw_fn(*a2, **k2)
+        return _wrap_outputs(out, None)
+
+    diff_arrays = [arrays[i] for i in diff_idx]
+
+    def f(*dargs):
+        buf = list(arrays)
+        for i, a in zip(diff_idx, dargs):
+            buf[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
+        return raw_fn(*a2, **k2)
+
+    out, vjp_fn = jax.vjp(f, *diff_arrays)
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    node = GradNode(
+        vjp_fn=vjp_fn,
+        inputs=[leaves[i] for i in diff_idx],
+        out_avals=[(tuple(o.shape), o.dtype) for o in out_leaves],
+        name=name or getattr(raw_fn, "__name__", "op"),
+        out_treedef=out_treedef,
+    )
+    return _wrap_outputs(out, node)
+
+
+def _wrap_outputs(out, node):
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        t = Tensor(o, stop_gradient=True)
+        # integer/bool outputs (argmax, indices, ...) never carry grad
+        if node is not None and _dtype_mod.is_inexact(o.dtype):
+            t._grad_node = node
+            t._out_index = i
+            t._stop_gradient = False
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def wrap_op(raw_fn: Callable = None, *, name: str = None):
+    """Turn a raw jax-array function into an eager Tensor op."""
+    def deco(fn):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def tensor_op(*args, **kwargs):
+            return call(fn, *args, name=op_name, **kwargs)
+
+        tensor_op.raw = fn
+        return tensor_op
+
+    if raw_fn is not None:
+        return deco(raw_fn)
+    return deco
+
+
+def shadow(t: Tensor) -> Tensor:
+    """Snapshot of a tensor's autograd identity, for in-place ops.
+
+    In-place ops redirect the original object's node pointer; recording the
+    original object as a node input would create a self-loop in the graph.
+    The shadow preserves the pre-mutation (array, node, index, hooks) so the
+    backward engine routes gradients exactly as if the mutation were the
+    functional op it lowers to.
+
+    A *leaf* that requires grad cannot be mutated in place while recording —
+    its accumulated .grad would land on the shadow, invisible to the user
+    (same restriction as the reference/torch eager mode).
+    """
+    if (is_grad_enabled() and t._grad_node is None
+            and not t._stop_gradient
+            and _dtype_mod.is_inexact(t._array.dtype)):
+        raise RuntimeError(
+            "a leaf Tensor with stop_gradient=False cannot be modified "
+            "in-place while autograd is recording; use paddle_tpu.no_grad() "
+            "or operate on a non-leaf (e.g. t * 1).")
+    s = Tensor.__new__(Tensor)
+    s._array = t._array
+    s._stop_gradient = t._stop_gradient
+    s._grad_node = t._grad_node
+    s._out_index = t._out_index
+    s.grad = None
+    s.name = t.name
+    s._backward_hooks = t._backward_hooks
+    s.persistable = False
+    return s
+
+
+def assign_inplace(t: Tensor, new: Tensor) -> Tensor:
+    """Redirect ``t`` to the functional result ``new`` (single home for the
+    in-place redirect used by methods._inplace and manipulation.setitem)."""
+    t._array = new._array
+    t._grad_node = new._grad_node
+    t._out_index = new._out_index
+    if new._grad_node is not None:
+        t._stop_gradient = False
+    return t
+
+
+def unwrap(x):
+    """Tensor -> jax array (idempotent for arrays/pytrees)."""
+    return jax.tree_util.tree_map(
+        lambda l: l._array if isinstance(l, Tensor) else l, x,
+        is_leaf=_TensorLeaf)

@@ -1,0 +1,211 @@
+"""The eager backward engine.
+
+Topology-ordered reverse traversal of the GradNode graph with fan-in
+accumulation — the structural analogue of the reference's
+egr::RunBackward (paddle/fluid/eager/backward.cc:522): a dependency-counted
+queue over grad nodes, a GradTensorHolder per node for cotangent
+accumulation, and leaf accumulation writing ``.grad``.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .grad_mode import no_grad
+from .tensor import GradNode, Tensor
+
+
+def _ones_like(arr):
+    return jnp.ones(arr.shape, arr.dtype)
+
+
+def _collect_graph(roots: List[GradNode]):
+    """Reachable nodes + per-node consumer-edge counts.
+
+    pending[n] = number of cotangent contributions node ``n`` will receive
+    from reachable consumer nodes before its vjp can run
+    (reference analogue: node_in_degree_map, backward.cc:449-483).
+    """
+    pending: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes[id(node)] = node
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None:
+                pending[id(prod)] = pending.get(id(prod), 0) + 1
+                if id(prod) not in seen:
+                    stack.append(prod)
+    return nodes, pending
+
+
+def run_backward(tensors: List[Tensor], grad_tensors: List[Optional[Tensor]],
+                 retain_graph: bool = False,
+                 inputs: Optional[List[Tensor]] = None,
+                 accumulate_into_grad: bool = True):
+    """Core engine. If ``inputs`` given, also return their gradients
+    (paddle.grad path); otherwise write ``.grad`` on leaves."""
+    with no_grad():
+        return _run(tensors, grad_tensors, retain_graph, inputs,
+                    accumulate_into_grad)
+
+
+def _run(tensors, grad_tensors, retain_graph, inputs, accumulate_into_grad):
+    # node-id -> list of accumulated cotangents per output position
+    buffers: Dict[int, list] = {}
+    # id(tensor) -> accumulated grad array (leaf accumulation)
+    leaf_grads: Dict[int, object] = {}
+    leaf_tensors: Dict[int, Tensor] = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._grad_node is None:
+            if not t._stop_gradient:
+                arr = g._array if g is not None else _ones_like(t._array)
+                leaf_grads[id(t)] = leaf_grads.get(id(t), 0) + arr
+                leaf_tensors[id(t)] = t
+            continue
+        node = t._grad_node
+        if g is None:
+            if t._array.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._array.shape)}")
+            g_arr = _ones_like(t._array)
+        else:
+            g_arr = g._array if isinstance(g, Tensor) else jnp.asarray(g)
+        buf = buffers.setdefault(id(node), [None] * len(node.out_avals))
+        cur = buf[t._out_index]
+        buf[t._out_index] = g_arr if cur is None else cur + g_arr
+        roots.append(node)
+
+    nodes, pending = _collect_graph(roots)
+
+    # the input-capture set for paddle.grad-style partial grads
+    capture: Dict[int, Tensor] = {id(t): t for t in (inputs or [])}
+    captured: Dict[int, object] = {}
+
+    ready = deque(n for n in {id(r): r for r in roots}.values()
+                  if pending.get(id(n), 0) == 0)
+    # roots that still have pending consumers wait their turn
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        buf = buffers.pop(id(node), [None] * len(node.out_avals))
+        cots = []
+        for aval, c in zip(node.out_avals, buf):
+            if c is None:
+                shape, dt = aval
+                import numpy as _np
+                import jax as _jx
+                if jnp.issubdtype(dt, jnp.inexact):
+                    c = jnp.zeros(shape, dt)
+                else:
+                    # integer/bool primal outputs take float0 cotangents
+                    c = _np.zeros(shape, _jx.dtypes.float0)
+            cots.append(c)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(set retain_graph=True to allow this).")
+        import jax as _jax
+        cot_tree = _jax.tree_util.tree_unflatten(node.out_treedef, cots)
+        in_grads = node.vjp_fn(cot_tree)
+        if not retain_graph:
+            node.vjp_fn = None
+
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                # still a consumed edge: decrement the producer's pending count
+                prod = t._grad_node
+                if prod is not None:
+                    pending[id(prod)] -= 1
+                    if pending[id(prod)] == 0:
+                        buffers.setdefault(id(prod),
+                                           [None] * len(prod.out_avals))
+                        ready.append(prod)
+                continue
+            # per-tensor gradient hooks
+            if t._backward_hooks:
+                gt = Tensor(g)
+                for hook in list(t._backward_hooks.values()):
+                    res = hook(gt)
+                    if res is not None:
+                        gt = res if isinstance(res, Tensor) else Tensor(res)
+                g = gt._array
+            if id(t) in capture:
+                captured[id(t)] = captured.get(id(t), 0) + g
+            prod = t._grad_node
+            if prod is None:
+                if not t._stop_gradient:
+                    leaf_grads[id(t)] = leaf_grads.get(id(t), 0) + g
+                    leaf_tensors[id(t)] = t
+                continue
+            pbuf = buffers.setdefault(id(prod), [None] * len(prod.out_avals))
+            cur = pbuf[t._out_index]
+            pbuf[t._out_index] = g if cur is None else cur + g
+            pending[id(prod)] -= 1
+            if pending[id(prod)] == 0:
+                ready.append(prod)
+
+    if accumulate_into_grad:
+        for tid, g in leaf_grads.items():
+            t = leaf_tensors[tid]
+            if t.grad is None:
+                t.grad = Tensor(g)
+            else:
+                t.grad = Tensor(t.grad._array + g)
+
+    if inputs is not None:
+        out = []
+        for t in inputs:
+            g = captured.get(id(t))
+            if g is None and id(t) in leaf_grads:
+                g = leaf_grads[id(t)]
+            out.append(Tensor(g) if g is not None else None)
+        return out
+    return None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad equivalent (reference: egr::Grad, backward.cc:808).
+
+    ``create_graph`` is not supported on the eager tape (use the functional
+    ``paddle_tpu.autograd`` transforms for higher-order grads).
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is unsupported; use "
+            "paddle_tpu.autograd.grad/vjp (functional) for higher-order grads.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+    grads = run_backward(list(outputs), list(grad_outputs),
+                         retain_graph=retain_graph, inputs=list(inputs),
+                         accumulate_into_grad=False)
+    if not allow_unused:
+        for t, g in zip(inputs, grads):
+            if g is None:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; pass "
+                    "allow_unused=True to return None for it.")
+    return grads

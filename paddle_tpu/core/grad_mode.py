@@ -1,0 +1,70 @@
+"""Global autograd-recording switch.
+
+Mirrors the reference's tracer on/off state (`paddle.no_grad`,
+reference: python/paddle/fluid/dygraph/base.py no_grad_) but as a simple
+nestable context manager / decorator.  When recording is off, ops execute
+their raw jax computation with no tape nodes created — this is also the mode
+used while tracing a compiled (``to_static``) step, where jax's own tracing
+provides differentiation.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+_grad_enabled = [True]
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled[0]
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager *and* direct setter, as in the reference API."""
+    return _GradScope(bool(mode))
+
+
+class _GradScope(contextlib.AbstractContextManager):
+    def __init__(self, mode):
+        self._mode = mode
+        self._prev = None
+        # act immediately so `set_grad_enabled(False)` works without `with`
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = mode
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False
+
+
+class no_grad(contextlib.ContextDecorator):
+    """``with paddle_tpu.no_grad(): ...`` or ``@paddle_tpu.no_grad()``."""
+
+    def __enter__(self):
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False
+
+    def __call__(self, func=None):
+        if func is None:
+            return self
+        @functools.wraps(func)
+        def wrapper(*a, **k):
+            with no_grad():
+                return func(*a, **k)
+        return wrapper
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False

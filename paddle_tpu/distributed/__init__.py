@@ -1,0 +1,70 @@
+"""paddle_tpu.distributed (reference surface: python/paddle/distributed/).
+
+Bootstrapping maps to jax.distributed (the TCPStore analogue,
+SURVEY.md N23); groups map to mesh axes; collectives map to lax primitives
+over ICI/DCN (N19/N22/N24 → §5.8).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from . import collective, mesh
+from .collective import (ReduceOp, all_gather, all_gather_object, all_reduce,
+                         all_to_all, all_to_all_single, alltoall, barrier,
+                         broadcast, get_group, irecv, isend, new_group, recv,
+                         reduce, reduce_scatter, scatter, send, wait)
+from .mesh import (CommunicateTopology, HybridCommunicateGroup, get_mesh,
+                   init_mesh, named_sharding, set_mesh)
+from .parallel_base import (DataParallel, ParallelEnv, get_rank,
+                            get_world_size, init_parallel_env, parallelize,
+                            shard_tensor, shard_dataloader)
+from . import fleet
+from .sharding import group_sharded_parallel, save_group_sharded_model
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "reduce", "scatter", "alltoall", "all_to_all", "send", "recv", "barrier",
+    "new_group", "get_group", "init_parallel_env", "get_rank",
+    "get_world_size", "ParallelEnv", "DataParallel", "init_mesh", "get_mesh",
+    "parallelize", "shard_tensor", "fleet", "spawn", "launch",
+]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: python/paddle/distributed/spawn.py.
+
+    On TPU the single-controller model replaces per-GPU process spawn: the
+    function runs once and pjit/shard_map fans work across devices.  For
+    API compatibility we run func(rank=0) inline when nprocs<=1 and use
+    multiprocessing otherwise (CPU testing only).
+    """
+    if nprocs in (-1, 0, 1):
+        return func(*args)
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+class launch:
+    """CLI launcher namespace (reference: python/paddle/distributed/launch).
+    TPU launch is typically one process per host started by the cluster
+    scheduler; `python -m paddle_tpu.distributed.launch_main` wraps that."""
+    pass

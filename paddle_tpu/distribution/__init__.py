@@ -1,0 +1,320 @@
+"""Probability distributions (reference surface: python/paddle/distribution/
+— Normal/Uniform/Categorical/Beta/Dirichlet/Multinomial/... with
+sample/log_prob/entropy/kl_divergence)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rnd
+from ..core.dispatch import call
+from ..core.tensor import Tensor
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        eps = jax.random.normal(_rnd.next_key(), shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return Tensor(-jnp.square(v - self.loc) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale), self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_rnd.next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(self._batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            _rnd.next_key(), self.probs, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(_rnd.next_key(),
+                                             jnp.log(jnp.maximum(self.logits, 1e-30))
+                                             if jnp.all(self.logits >= 0)
+                                             else self.logits,
+                                             shape=shape).astype(jnp.int64))
+
+    def _log_pmf(self):
+        # paddle Categorical accepts unnormalised positive weights
+        logits = self.logits
+        logits = jnp.where(jnp.all(logits >= 0), jnp.log(jnp.maximum(logits, 1e-30)), logits)
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        lp = self._log_pmf()
+        return Tensor(jnp.take_along_axis(lp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        lp = self._log_pmf()
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(_rnd.next_key(), self.alpha, self.beta,
+                                      shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(_rnd.next_key(),
+                                           self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        norm = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
+                - jax.scipy.special.gammaln(jnp.sum(c, axis=-1)))
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), axis=-1) - norm)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(_rnd.next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(_rnd.next_key(), self.concentration,
+                                       shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.laplace(_rnd.next_key(), shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2 * self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape[:-1], self.probs_arr.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs_arr.shape[-1]
+        draws = jax.random.categorical(
+            _rnd.next_key(), jnp.log(jnp.maximum(self.probs_arr, 1e-30)),
+            shape=tuple(shape) + self._batch_shape + (self.total_count,))
+        return Tensor(jax.nn.one_hot(draws, n).sum(axis=-2))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.maximum(self.probs_arr, 1e-30))
+        gl = jax.scipy.special.gammaln
+        return Tensor(gl(jnp.asarray(self.total_count + 1.0))
+                      - jnp.sum(gl(v + 1.0), axis=-1)
+                      + jnp.sum(v * logp, axis=-1))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """reference: python/paddle/distribution/kl.py."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp, lq = p._log_pmf(), q._log_pmf()
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        return Tensor(pp * jnp.log(pp / qq)
+                      + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+        return Tensor(
+            gl(pa + pb) - gl(pa) - gl(pb) - gl(qa + qb) + gl(qa) + gl(qb)
+            + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+            + (qa - pa + qb - pb) * dg(pa + pb))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

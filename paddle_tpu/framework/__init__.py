@@ -1,0 +1,108 @@
+"""Framework-level utilities: save/load, dtype defaults, RNG
+(reference: python/paddle/framework/)."""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from ..core import seed, get_rng_state, set_rng_state  # noqa: F401
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (arrays as numpy + dtype tag)."""
+
+    def __init__(self, t: Tensor):
+        self.array = np.asarray(t._array)
+        self.is_parameter = isinstance(t, Parameter)
+        self.name = t.name
+        self.stop_gradient = t.stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, _TensorPayload):
+        if obj.is_parameter:
+            t = Parameter(obj.array, name=obj.name)
+        else:
+            t = Tensor(obj.array)
+            t.name = obj.name
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save equivalent (reference: python/paddle/framework/io.py:568).
+
+    Accepts nested state_dicts of Tensors; path may be a file path or a
+    writable file-like object.
+    """
+    payload = _pack(obj)
+    if hasattr(path, "write"):
+        pickle.dump(payload, path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load equivalent (reference: python/paddle/framework/io.py:784)."""
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path))
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))
+
+
+def save_to_memory(obj):
+    buf = _io.BytesIO()
+    save(obj, buf)
+    buf.seek(0)
+    return buf
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, idx=0):
+        self.idx = idx
+
+    def __repr__(self):
+        return f"TPUPlace({self.idx})"
+
+
+# API-compat aliases: "CUDAPlace" = the accelerator place
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+
+
+def in_dynamic_mode():
+    return True
+
+
+in_dygraph_mode = in_dynamic_mode

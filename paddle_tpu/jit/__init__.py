@@ -1,0 +1,228 @@
+"""paddle_tpu.jit — the compiled training/inference path.
+
+The analogue of the reference's dy2static + executors
+(python/paddle/jit/to_static, fluid/executor.py, new_executor/InterpreterCore):
+instead of AST transformation to a ProgramDesc interpreted by a C++ runtime,
+a Layer's forward is *traced through jax.jit* into one XLA executable.
+
+Three pieces:
+* ``functional_call(layer, state, *args)`` — run a Layer against an external
+  {name: array} state pytree (params + buffers), returning outputs plus the
+  updated buffer state (running BN stats etc.).
+* ``to_static(layer_or_fn)`` — paddle.jit.to_static equivalent; returns a
+  compiled callable with the same signature.
+* ``TrainStep`` — the Executor analogue: one jitted (and optionally pjit-
+  sharded) function computing loss, grads and optimizer update.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rnd
+from ..core.grad_mode import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static"]
+
+
+def _unwrap(x):
+    return x._array if isinstance(x, Tensor) else x
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        _unwrap, tree, is_leaf=lambda l: isinstance(l, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(lambda l: Tensor(l) if hasattr(l, "dtype") else l, tree)
+
+
+def functional_call(layer: Layer, state: Dict[str, Any], *args,
+                    rng=None, **kwargs):
+    """Run ``layer`` with parameters/buffers taken from ``state``.
+
+    Returns ``(outputs, new_state)`` where new_state reflects any buffer
+    mutation during forward (e.g. batch-norm running stats).  Pure w.r.t.
+    (state, args, rng) — safe to trace under jit/grad.
+    """
+    sd = layer.state_dict()
+    old = {k: t._array for k, t in sd.items()}
+    try:
+        for k, arr in state.items():
+            if k in sd:
+                sd[k]._array = arr
+        ctx = _rnd.key_stream(rng) if rng is not None else _nullcontext()
+        with no_grad(), ctx:
+            out = layer(*args, **kwargs)
+        new_state = {k: sd[k]._array for k in state.keys() if k in sd}
+        out_arrays = _unwrap_tree(out)
+        return out_arrays, new_state
+    finally:
+        for k, arr in old.items():
+            sd[k]._array = arr
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+class StaticFunction:
+    """Compiled wrapper around a Layer or function
+    (reference: program_translator.py:236 StaticFunction)."""
+
+    def __init__(self, target, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._target = target
+        self._is_layer = isinstance(target, Layer)
+        if self._is_layer:
+            self._jitted = jax.jit(self._layer_core)
+        else:
+            self._jitted = jax.jit(self._fn_core)
+
+    def _layer_core(self, state, rng, args, kwargs):
+        out, new_state = functional_call(self._target, state, *args,
+                                         rng=rng, **kwargs)
+        return out, new_state
+
+    def _fn_core(self, rng, args, kwargs):
+        with no_grad(), _rnd.key_stream(rng):
+            out = self._target(*_wrap_tree(args), **_wrap_tree(kwargs))
+        return _unwrap_tree(out)
+
+    def __call__(self, *args, **kwargs):
+        rng = _rnd.next_key()
+        args_a = _unwrap_tree(args)
+        kwargs_a = _unwrap_tree(kwargs)
+        if self._is_layer:
+            state = self._target.functional_state()
+            out, new_state = self._jitted(state, rng, args_a, kwargs_a)
+            self._target.load_functional_state(new_state)
+            return _wrap_tree(out)
+        return _wrap_tree(self._jitted(rng, args_a, kwargs_a))
+
+    # introspection API parity
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(
+                self._target.forward if self._is_layer else self._target)
+        except Exception:
+            return "<source unavailable>"
+
+    def concrete_program(self):
+        return self._jitted
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static equivalent: compile a Layer/function via jax.jit."""
+    def deco(target):
+        return StaticFunction(target, input_spec, build_strategy, backend)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TrainStep:
+    """One fused, compiled training step: forward + backward + optimizer.
+
+    The TPU-native Executor: what the reference splits across
+    Tracer/autograd/optimizer ops scheduled by InterpreterCore
+    (framework/new_executor/interpretercore.cc) is here ONE XLA program —
+    loss, grads (jax.grad), update — with every elementwise chain fused.
+
+    Batch convention: ``step(*batch)`` sends ``batch[:num_inputs]`` to the
+    model and the rest (labels) to ``loss_fn(*outputs, *labels)`` — all as
+    traced arguments, so every batch is fresh data to the same compiled
+    program.
+
+    Usage:
+        step = TrainStep(model, loss_fn, opt)
+        for x, y in loader:
+            loss = step(x, y)
+        step.sync_to_model()   # write trained arrays back into model/opt
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 num_inputs: int = 1, in_shardings=None, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.num_inputs = num_inputs
+        full_state = model.functional_state()
+        trainable = {name for name, p in model.named_parameters()
+                     if not p.stop_gradient}
+        # copy so the first donated step cannot invalidate the eager model's
+        # own buffers
+        copy = (lambda v: jnp.array(v)) if donate else (lambda v: v)
+        self.params = {k: copy(v) for k, v in full_state.items()
+                       if k in trainable}
+        self.buffers = {k: copy(v) for k, v in full_state.items()
+                        if k not in trainable}
+        self.opt_state = optimizer.init_state(self.params)
+        self._dirty = True
+
+        def loss_core(params, buffers, rng, batch):
+            state = {**params, **buffers}
+            self.model.train()
+            inputs = batch[:self.num_inputs]
+            labels = batch[self.num_inputs:]
+            out, new_state = functional_call(self.model, state, *inputs,
+                                             rng=rng)
+            outs = out if isinstance(out, tuple) else (out,)
+            with no_grad():  # jax traces the grad; keep the eager tape off
+                loss = self.loss_fn(
+                    *[Tensor(o) if not isinstance(o, Tensor) else o
+                      for o in outs],
+                    *[Tensor(l) if not isinstance(l, Tensor) else l
+                      for l in labels])
+            if isinstance(loss, Tensor):
+                loss = loss._array
+            new_buffers = {k: new_state[k] for k in buffers.keys()}
+            return loss, new_buffers
+
+        def step_fn(params, buffers, opt_state, lr, rng, batch):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_core, has_aux=True)(params, buffers, rng, batch)
+            new_params, new_opt_state = self.optimizer.apply_gradients(
+                params, grads, opt_state, lr)
+            return loss, new_params, new_buffers, new_opt_state
+
+        donate_args = (0, 1, 2) if donate else ()
+        self._step = jax.jit(step_fn, donate_argnums=donate_args)
+
+    def __call__(self, *batch):
+        rng = _rnd.next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        batch_a = _unwrap_tree(batch)
+        loss, self.params, self.buffers, self.opt_state = self._step(
+            self.params, self.buffers, self.opt_state, lr, rng, batch_a)
+        self._dirty = True
+        if isinstance(self.optimizer._learning_rate, object) and hasattr(
+                self.optimizer._learning_rate, "step"):
+            try:
+                self.optimizer._learning_rate.step()
+            except TypeError:
+                pass
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the trained arrays back into the eager model."""
+        self.model.load_functional_state({**self.params, **self.buffers})
+        self._dirty = False

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops.
+
+The TPU-native replacement for the reference's hand-fused CUDA kernels
+(paddle/fluid/operators/fused/): flash attention, fused layernorm, fused
+optimizer updates.  Every kernel has an XLA fallback so the framework runs
+anywhere jax runs; kernels self-gate via their ``supported()`` predicates.
+"""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,51 @@
+"""Flash attention (Pallas TPU).
+
+Blockwise-softmax attention with O(S) memory — the capability the reference
+lacks entirely (SURVEY.md §5.7: no flash/ring attention in the snapshot; its
+fused FMHA paddle/fluid/operators/fused/fmha_ref.h is still O(S^2)).
+
+v1 strategy: Pallas forward kernel + recompute-based backward via custom_vjp.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_BLOCK_Q = 128
+_DEFAULT_BLOCK_K = 128
+
+
+def _platform() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def supported(q, k=None) -> bool:
+    """Whether the Pallas path applies to (B, S, H, D) query/key.
+
+    Restricted to square self-attention (s_q == s_k, both block-aligned):
+    the kernel's causal mask is start-aligned and a ragged key tail would be
+    silently dropped — cross/cached attention takes the XLA reference path.
+    """
+    if _platform() != "tpu":
+        return False
+    if q.ndim != 4:
+        return False
+    s, d = q.shape[1], q.shape[3]
+    if k is not None and k.shape[1] != s:
+        return False
+    return s % _DEFAULT_BLOCK_Q == 0 and d in (64, 128, 256)
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """q,k,v: (B, S, H, D) -> (B, S, H, D)."""
+    from .flash_attention_pallas import flash_attention_bhsd
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhsd(qt, kt, vt, causal=causal, scale=scale)
+    return jnp.swapaxes(out, 1, 2)

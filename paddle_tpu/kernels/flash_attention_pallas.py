@@ -1,0 +1,154 @@
+"""Pallas TPU flash-attention kernel.
+
+Blockwise streaming-softmax attention (Flash-Attention style): the query
+block lives in VMEM, K/V are scanned block-by-block with running (max, sum,
+acc) statistics in fp32, so score matrices never materialise in HBM —
+O(S) memory instead of the reference FMHA's O(S^2)
+(paddle/fluid/operators/fused/fmha_ref.h).
+
+v1 backward = recompute-based custom_vjp (XLA reference attention under
+jax.vjp); a dedicated Pallas backward kernel is a later optimisation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, S, D); o_ref: (1, BQ, D)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    s = k_ref.shape[1]
+    # strong int32: program_id is weakly typed and x64 mode would promote
+    # its arithmetic to i64, which mosaic cannot lower
+    qi = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # (BQ, D)
+
+    m0 = jnp.full((block_q,), jnp.float32(_NEG_INF), jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    # all index math in explicit-int32 lax ops: under jax x64 mode any
+    # python-int mixing can surface i64, which mosaic cannot lower
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    row_ids = jax.lax.mul(qi, i32(block_q))[None, None] + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        start = jax.lax.mul(kb, i32(block_k))
+        k = k_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            col_ids = start[None, None] + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(col_ids <= row_ids, logits, jnp.float32(_NEG_INF))
+        blk_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[:, None])
+        new_l = l * correction + jnp.sum(p, axis=-1)
+        new_acc = acc * correction[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    if causal:
+        assert block_q % block_k == 0
+        num_kb = jax.lax.mul(jax.lax.add(qi, i32(1)),
+                             i32(block_q // block_k))
+    else:
+        num_kb = i32(s // block_k)
+    m, l, acc = jax.lax.fori_loop(i32(0), num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, jnp.float32(1e-30))[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret=False):
+    # trace the kernel with x64 off: the global x64 mode (needed for paddle's
+    # int64 semantics) surfaces i64/f64 intermediates that mosaic cannot lower
+    with jax.enable_x64(False):
+        return _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k,
+                                interpret)
+
+
+def _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    bh = b * h
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, k.shape[2], d)
+    v3 = v.reshape(bh, v.shape[2], d)
+    nq = s // block_q
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, k3.shape[1], d), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, v3.shape[1], d), lambda bi, i: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d)
+
+
+def _reference_bhsd(q, k, v, causal, scale):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-based backward: differentiate the XLA reference (remat'd so the
+    # S^2 score matrix only exists transiently inside the fused backward)
+    _, vjp = jax.vjp(
+        jax.checkpoint(lambda q_, k_, v_: _reference_bhsd(q_, k_, v_, causal, scale)),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
+    """q,k,v: (B, H, S, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = q.shape[2]
+    block_q = min(block_q, s)
+    block_k = min(block_k, k.shape[2])
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)

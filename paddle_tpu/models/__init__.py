@@ -1,0 +1,4 @@
+"""Model zoo: language models (GPT-2 flagship) + vision re-exports."""
+from ..vision.models import (LeNet, ResNet, resnet18, resnet50)  # noqa: F401
+from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,  # noqa: F401
+                  GPTPretrainingCriterion, gpt2_345m)

@@ -1,0 +1,248 @@
+"""GPT-2 — the flagship language model (reference capability target:
+BASELINE.md config 4, "GPT-2 345M ... fused attention/FFN"; the reference's
+closest in-tree models are fleet's GPT test models,
+python/paddle/fluid/tests/unittests/auto_parallel_gpt_model.py).
+
+TPU-first design:
+* pre-LN transformer, bf16-friendly, weight-tied logits
+* attention via F.scaled_dot_product_attention -> Pallas flash kernel
+* Megatron sharding ANNOTATIONS baked into the parameters (pspec): qkv/fc1
+  column-sharded on 'mp', out-proj/fc2 row-sharded, embeddings vocab-sharded;
+  activations constrained to ('dp', 'sep', None) so sequence parallelism
+  shards the token axis.  Under pjit these annotations are the whole
+  distribution strategy (GSPMD inserts the collectives the reference's
+  mp_layers/c_* ops hand-coded).
+* vocab padded to a multiple of 128 so the logits matmul tiles the MXU.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from jax.sharding import PartitionSpec
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..distributed.mp_layers import with_sharding_constraint
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.norm import LayerNorm
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to 128-multiple (MXU tiling)
+    max_position_embeddings: int = 1024
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def gpt2_small(cls):
+        return cls(hidden_size=768, num_hidden_layers=12,
+                   num_attention_heads=12, intermediate_size=3072)
+
+    @classmethod
+    def gpt2_medium(cls):  # the 345M benchmark config
+        return cls(hidden_size=1024, num_hidden_layers=24,
+                   num_attention_heads=16, intermediate_size=4096)
+
+    @classmethod
+    def gpt2_large(cls):
+        return cls(hidden_size=1280, num_hidden_layers=36,
+                   num_attention_heads=20, intermediate_size=5120)
+
+    @classmethod
+    def tiny(cls):  # for tests
+        return cls(vocab_size=512, max_position_embeddings=128,
+                   hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.hidden_size = c.hidden_size
+        init = I.Normal(0.0, c.initializer_range)
+        out_init = I.Normal(0.0, c.initializer_range
+                            / math.sqrt(2 * c.num_hidden_layers))
+        self.qkv_proj = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.qkv_proj.weight.set_value(Tensor(init((c.hidden_size,
+                                                    3 * c.hidden_size))))
+        self.out_proj = Linear(c.hidden_size, c.hidden_size)
+        self.out_proj.weight.set_value(Tensor(out_init((c.hidden_size,
+                                                        c.hidden_size))))
+        self.attn_dropout_p = c.attention_dropout_prob
+        self.resid_dropout = Dropout(c.hidden_dropout_prob)
+        # Megatron layout: qkv column-sharded, out row-sharded
+        self.qkv_proj.weight.pspec = PartitionSpec(None, "mp")
+        self.qkv_proj.bias.pspec = PartitionSpec("mp")
+        self.out_proj.weight.pspec = PartitionSpec("mp", None)
+
+    def forward(self, x, cache=None):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)   # (b, s, h, d) each
+        if cache is not None:
+            pk, pv = cache
+            k = ops.concat([pk, k], axis=1)
+            v = ops.concat([pv, v], axis=1)
+            cache = (k, v)
+        # always causal: the reference SDPA mask is end-aligned
+        # (tril offset sk-sq), which is exactly right for cached decode —
+        # each new token sees the full past plus itself, never its future
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_dropout_p, is_causal=True,
+            training=self.training)
+        out = ops.reshape(out, [b, s, self.hidden_size])
+        out = self.resid_dropout(self.out_proj(out))
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = I.Normal(0.0, c.initializer_range)
+        out_init = I.Normal(0.0, c.initializer_range
+                            / math.sqrt(2 * c.num_hidden_layers))
+        self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+        self.fc1.weight.set_value(Tensor(init((c.hidden_size,
+                                               c.intermediate_size))))
+        self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+        self.fc2.weight.set_value(Tensor(out_init((c.intermediate_size,
+                                                   c.hidden_size))))
+        self.dropout = Dropout(c.hidden_dropout_prob)
+        self.fc1.weight.pspec = PartitionSpec(None, "mp")
+        self.fc1.bias.pspec = PartitionSpec("mp")
+        self.fc2.weight.pspec = PartitionSpec("mp", None)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln1(x), cache)
+            x = x + a
+        else:
+            x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        # sequence-parallel activation layout: tokens sharded over 'sep'
+        x = with_sharding_constraint(x, PartitionSpec("dp", "sep", None))
+        if cache is not None:
+            return x, cache
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        init = I.Normal(0.0, c.initializer_range)
+        self.wte = Embedding(c.vocab_size, c.hidden_size)
+        self.wte.weight.set_value(Tensor(init((c.vocab_size, c.hidden_size))))
+        self.wte.weight.pspec = PartitionSpec("mp", None)   # vocab-parallel
+        self.wpe = Embedding(c.max_position_embeddings, c.hidden_size)
+        self.wpe.weight.set_value(
+            Tensor(init((c.max_position_embeddings, c.hidden_size))))
+        self.drop = Dropout(c.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
+        self.ln_f = LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, cache=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            start = 0 if cache is None else cache[0][0].shape[1]
+            position_ids = ops.arange(start, start + s, dtype="int32")
+            position_ids = ops.unsqueeze(position_ids, 0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        x = with_sharding_constraint(x, PartitionSpec("dp", "sep", None))
+        new_caches = []
+        for i, block in enumerate(self.h):
+            if cache is not None:
+                x, ci = block(x, cache[i])
+                new_caches.append(ci)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if cache is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """LM head with tied embeddings; loss computed from shifted logits."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+            self.lm_head.weight.pspec = PartitionSpec(None, "mp")
+
+    def forward(self, input_ids, position_ids=None, cache=None):
+        if cache is not None:
+            x, cache = self.gpt(input_ids, position_ids, cache)
+        else:
+            x = self.gpt(input_ids, position_ids)
+        if self.config.tie_word_embeddings:
+            logits = ops.matmul(x, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if cache is not None:
+            return logits, cache
+        return logits
+
+    def gen_cache(self, batch_size, dtype="float32"):
+        c = self.config
+        empty = ops.zeros(
+            [batch_size, 0, c.num_attention_heads,
+             c.hidden_size // c.num_attention_heads], dtype)
+        return [(empty, empty) for _ in range(c.num_hidden_layers)]
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shifted-causal-LM loss (reference analogue: the fleet GPT model's
+    criterion)."""
+
+    def forward(self, logits, labels, loss_mask=None):
+        shifted = logits[:, :-1, :]
+        targets = labels[:, 1:]
+        loss = F.cross_entropy(shifted, targets, reduction="none")
+        if loss_mask is not None:
+            mask = loss_mask[:, 1:]
+            return ops.sum(loss * mask) / ops.maximum(
+                ops.sum(mask), ops.to_tensor(1.0))
+        return ops.mean(loss)
+
+
+def gpt2_345m():
+    return GPTForCausalLM(GPTConfig.gpt2_medium())

@@ -1,0 +1,75 @@
+"""paddle_tpu.nn (reference surface: python/paddle/nn/)."""
+from . import functional
+from . import initializer
+from .layer.layers import (Layer, LayerList, ParameterList, Sequential)
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                           CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                           Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+                           Pad2D, Pad3D, PixelShuffle, PixelUnshuffle, Unfold,
+                           Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                         Conv3D, Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm, RMSNorm,
+                         SpectralNorm, SyncBatchNorm)
+from .layer.activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid,
+                               Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
+                               LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+                               RReLU, SELU, Sigmoid, Silu, Softmax, Softplus,
+                               Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                               ThresholdedReLU)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D,
+                            MaxPool1D, MaxPool2D, MaxPool3D)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                         NLLLoss, SmoothL1Loss, TripletMarginLoss)
+from .layer.transformer import (MultiHeadAttention, Transformer,
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+from .layer.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, SimpleRNN,
+                        SimpleRNNCell, RNNCellBase)
+from .parallel import DataParallel
+
+from ..core.tensor import Parameter  # noqa: F401 — nn.Parameter alias
+
+
+class ParameterAttr:
+    """paddle.ParamAttr equivalent — carries name/initializer/lr/regularizer."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+ParamAttr = ParameterAttr
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    from .clip import clip_grad_norm_ as _impl
+    return _impl(parameters, max_norm, norm_type)
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min

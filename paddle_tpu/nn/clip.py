@@ -1,0 +1,31 @@
+"""Gradient clipping utilities (reference: python/paddle/fluid/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    """In-place global-norm clip over parameters' ``.grad``."""
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._array)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._array) ** norm_type) for g in grads]))
+        total = total ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._array = g._array * clip_coef.astype(g._array.dtype)
+    return Tensor(total)
+
+
+def clip_grads_by_global_norm_tree(grads_tree_leaves, clip_norm):
+    """Functional global-norm clip over a list of grad arrays (compiled path)."""
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in grads_tree_leaves))
+    coef = jnp.minimum(clip_norm / (total + 1e-6), 1.0)
+    return [g * coef.astype(g.dtype) for g in grads_tree_leaves], total

@@ -1,0 +1,108 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import wrap_op
+
+relu = wrap_op(jax.nn.relu, name="relu")
+relu6 = wrap_op(jax.nn.relu6, name="relu6")
+elu = wrap_op(lambda x, alpha=1.0: jax.nn.elu(x, alpha), name="elu")
+selu = wrap_op(lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+               scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)), name="selu")
+celu = wrap_op(lambda x, alpha=1.0: jax.nn.celu(x, alpha), name="celu")
+gelu = wrap_op(lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate),
+               name="gelu")
+silu = wrap_op(jax.nn.silu, name="silu")
+swish = silu
+mish = wrap_op(lambda x: x * jnp.tanh(jax.nn.softplus(x)), name="mish")
+sigmoid = wrap_op(jax.nn.sigmoid, name="sigmoid")
+hardsigmoid = wrap_op(lambda x, slope=1.0 / 6, offset=0.5:
+                      jnp.clip(slope * x + offset, 0.0, 1.0), name="hardsigmoid")
+hardswish = wrap_op(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+                    name="hardswish")
+hardtanh = wrap_op(lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max),
+                   name="hardtanh")
+hardshrink = wrap_op(lambda x, threshold=0.5:
+                     jnp.where(jnp.abs(x) > threshold, x, 0.0), name="hardshrink")
+softshrink = wrap_op(lambda x, threshold=0.5:
+                     jnp.where(x > threshold, x - threshold,
+                               jnp.where(x < -threshold, x + threshold, 0.0)),
+                     name="softshrink")
+tanhshrink = wrap_op(lambda x: x - jnp.tanh(x), name="tanhshrink")
+leaky_relu = wrap_op(lambda x, negative_slope=0.01:
+                     jax.nn.leaky_relu(x, negative_slope), name="leaky_relu")
+log_sigmoid = wrap_op(jax.nn.log_sigmoid, name="log_sigmoid")
+softplus = wrap_op(lambda x, beta=1.0, threshold=20.0:
+                   jnp.where(beta * x > threshold, x,
+                             jnp.log1p(jnp.exp(beta * x)) / beta),
+                   name="softplus")
+softsign = wrap_op(jax.nn.soft_sign, name="softsign")
+tanh = wrap_op(jnp.tanh, name="tanh")
+thresholded_relu = wrap_op(lambda x, threshold=1.0:
+                           jnp.where(x > threshold, x, 0.0),
+                           name="thresholded_relu")
+
+
+@wrap_op
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@wrap_op
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@wrap_op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ...core import random as _rnd
+    g = jax.random.gumbel(_rnd.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        hard_y = jnp.zeros_like(y)
+        hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+        y = hard_y + y - jax.lax.stop_gradient(y)
+    return y
+
+
+@wrap_op
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 2:
+        if data_format == "NCHW":
+            w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+        else:
+            w = w.reshape((1,) * (x.ndim - 1) + (-1,))
+    return jnp.where(x > 0, x, w * x)
+
+
+@wrap_op
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@wrap_op
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@wrap_op
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    from ...core import random as _rnd
+    if training:
+        slope = jax.random.uniform(_rnd.next_key(), x.shape, x.dtype,
+                                   minval=lower, maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)

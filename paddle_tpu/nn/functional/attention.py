@@ -1,0 +1,69 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` routes to the Pallas flash-attention kernel
+on TPU (paddle_tpu.kernels.flash_attention) and to a reference XLA
+implementation elsewhere — the TPU-native answer to the reference's fused
+FMHA (paddle/fluid/operators/fused/fmha_ref.h, fused_attention_op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call
+from ...core.tensor import Tensor
+
+
+def sdpa_reference_raw(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                       scale=None, dropout_key=None):
+    """Plain-XLA attention. q/k/v: (B, S, H, D) paddle layout."""
+    bthd = q.ndim == 4
+    if bthd:
+        q_ = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
+        k_ = jnp.swapaxes(k, 1, 2)
+        v_ = jnp.swapaxes(v, 1, 2)
+    else:
+        q_, k_, v_ = q, k, v
+    d = q_.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, q_.dtype))
+    logits = jnp.einsum("...qd,...kd->...qk", q_, k_) * s
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q_.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("...qk,...kd->...qd", probs, v_)
+    if bthd:
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None,
+                                 use_flash=True):
+    """q/k/v: (batch, seq, heads, head_dim) — reference layout
+    (python/paddle incubate FusedMultiHeadAttention input layout)."""
+    from ...core import random as _rnd
+    dropout_key = _rnd.next_key() if (dropout_p > 0.0 and training) else None
+    if not training:
+        dropout_p = 0.0
+
+    def raw(q, k, v, m):
+        if use_flash and m is None and dropout_p == 0.0:
+            from ...kernels import flash_attention as fa
+            if fa.supported(q, k):
+                return fa.flash_attention_bshd(q, k, v, causal=is_causal,
+                                               scale=scale)
+        return sdpa_reference_raw(q, k, v, m, dropout_p, is_causal, scale,
+                                  dropout_key)
+
+    return call(raw, query, key, value, attn_mask, name="sdpa")

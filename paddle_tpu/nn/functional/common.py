@@ -1,0 +1,261 @@
+"""Common functionals: linear, dropout, pad, embedding, interpolate, ...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _rnd
+from ...core.dispatch import call, wrap_op
+from ...core.tensor import Tensor
+
+
+@wrap_op
+def linear(x, weight, bias=None):
+    # paddle stores Linear weight as (in, out): y = x @ W + b
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rnd.next_key()
+
+    def raw(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+    return call(raw, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    key = _rnd.next_key()
+
+    def raw(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return call(raw, x, name="alpha_dropout")
+
+
+@wrap_op
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def one_hot(x, num_classes):
+    return call(lambda a: jax.nn.one_hot(a, num_classes), x, name="one_hot")
+
+
+@wrap_op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = list(int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == nd * 2:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered (left, right, top, bottom, front, back) for NCHW-family
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial_dims = list(range(nd - n_spatial, nd))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        # reverse: pad is given innermost-dim-first
+        for i, d in enumerate(reversed(spatial_dims)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    if mode == "reflect":
+        return jnp.pad(x, cfg, mode="reflect")
+    if mode == "replicate":
+        return jnp.pad(x, cfg, mode="edge")
+    if mode == "circular":
+        return jnp.pad(x, cfg, mode="wrap")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+@wrap_op
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p == 2:
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        denom = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(denom, epsilon)
+
+
+@wrap_op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@wrap_op
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@wrap_op
+def bilinear(x1, x2, weight, bias=None):
+    # weight: (out, in1, in2)
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    def raw(a):
+        nchw = data_format.upper().startswith("NC")
+        if not nchw:
+            # to NCHW-like
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a = jnp.transpose(a, perm)
+        spatial = a.shape[2:]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in
+                                (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_spatial = tuple(int(np.floor(s * f)) for s, f in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "trilinear": "trilinear", "bicubic": "bicubic",
+                  "linear": "linear", "area": "linear"}[mode]
+        if mode == "nearest":
+            # jax.image nearest matches paddle align_corners=False
+            out = jax.image.resize(a, a.shape[:2] + out_spatial, method="nearest")
+        elif align_corners:
+            out = _resize_align_corners(a, out_spatial, method)
+        else:
+            out = jax.image.resize(a, a.shape[:2] + out_spatial, method=method)
+        if not nchw:
+            perm = (0,) + tuple(range(2, out.ndim)) + (1,)
+            out = jnp.transpose(out, perm)
+        return out
+
+    return call(raw, x, name="interpolate")
+
+
+def _resize_align_corners(a, out_spatial, method):
+    # align_corners=True: sample at exact corner-aligned grid via map_coordinates
+    spatial = a.shape[2:]
+    coords = []
+    for s_in, s_out in zip(spatial, out_spatial):
+        if s_out == 1:
+            c = jnp.zeros((1,))
+        else:
+            c = jnp.linspace(0.0, s_in - 1.0, s_out)
+        coords.append(c)
+    mesh = jnp.meshgrid(*coords, indexing="ij")
+    order = 0 if method == "nearest" else 1
+
+    def per_image(img):
+        return jax.scipy.ndimage.map_coordinates(img, mesh, order=order)
+
+    return jax.vmap(jax.vmap(per_image))(a)
+
+
+upsample = interpolate
+
+
+@wrap_op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@wrap_op
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    raise NotImplementedError
+
+
+@wrap_op
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.transpose(x, (0, 2, 1, 3, 4))
+        return x.reshape(n, c, h, w)
+    raise NotImplementedError
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    from ...ops.manipulation import unfold as _unfold
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+@wrap_op
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    n, ckk, L = x.shape
+    c = ckk // (ks[0] * ks[1])
+    oh, ow = output_sizes
+    lh = (oh + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+    lw = (ow + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+    cols = x.reshape(n, c, ks[0], ks[1], lh, lw)
+    out = jnp.zeros((n, c, oh + 2 * pd[0], ow + 2 * pd[1]), x.dtype)
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            hi = i * dl[0]
+            wj = j * dl[1]
+            out = out.at[:, :, hi:hi + lh * st[0]:st[0],
+                         wj:wj + lw * st[1]:st[1]].add(cols[:, :, i, j])
+    return out[:, :, pd[0]:pd[0] + oh, pd[1]:pd[1] + ow]

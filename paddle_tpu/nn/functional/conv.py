@@ -1,0 +1,170 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py).
+
+All convs lower to one XLA ``conv_general_dilated`` — the TPU equivalent of
+the reference's cuDNN path (paddle/phi/kernels/gpudnn/conv_kernel.cu).  The
+public layout default is NCHW for API parity; XLA's layout assignment picks
+the TPU-friendly internal layout, so no manual NHWC transposes are needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import wrap_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, stride, ksize, dilation):
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            return "SAME"
+        if padding.upper() == "VALID":
+            return "VALID"
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return [(int(v), int(v)) for v in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+        if len(p) == 1:
+            return [(int(p[0]), int(p[0]))] * n
+    return [(int(padding), int(padding))] * n
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last):
+    # paddle weights are (out, in/groups, *k) regardless of data_format
+    dn = _dim_numbers(n, channel_last)
+    if channel_last:
+        # convert OIHW-style weight to HWIO-style
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        weight = jnp.transpose(weight, perm)
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@wrap_op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    cl = data_format in ("NLC",)
+    return _conv_nd(x, weight, bias, _tuple(stride, 1),
+                    _padding(padding, 1, stride, weight.shape[-1:], dilation),
+                    _tuple(dilation, 1), groups, 1, cl)
+
+
+@wrap_op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    cl = data_format == "NHWC"
+    return _conv_nd(x, weight, bias, _tuple(stride, 2),
+                    _padding(padding, 2, stride, weight.shape[-2:], dilation),
+                    _tuple(dilation, 2), groups, 2, cl)
+
+
+@wrap_op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    cl = data_format == "NDHWC"
+    return _conv_nd(x, weight, bias, _tuple(stride, 3),
+                    _padding(padding, 3, stride, weight.shape[-3:], dilation),
+                    _tuple(dilation, 3), groups, 3, cl)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, channel_last):
+    # paddle transpose-conv weight: (in, out/groups, *k)
+    dn = _dim_numbers(n, channel_last)
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    ksz = weight.shape[2:]
+    pad = _padding(padding, n, stride, ksz, dilation)
+    if isinstance(pad, str):
+        pad_pairs = None
+    else:
+        pad_pairs = pad
+    # gradient-of-conv formulation: lhs_dilation = stride
+    if pad_pairs is None:
+        trans_pad = pad
+    else:
+        trans_pad = [
+            (d * (k - 1) - p[0], d * (k - 1) - p[1] + op)
+            for k, p, d, op in zip(ksz, pad_pairs, dilation, opad)]
+    # weight (in, out/groups, *k) -> flip spatial, to (out, in/groups, *k)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ci = w.shape[0]
+        w = w.reshape((groups, ci // groups) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((w.shape[0] * w.shape[1], ci // groups) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(w, perm)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * n,
+        padding=trans_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=_dim_numbers(n, channel_last),
+        feature_group_count=groups)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@wrap_op
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format == "NLC")
+
+
+@wrap_op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", output_size=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format == "NHWC")
+
+
+@wrap_op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", output_size=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format == "NDHWC")

@@ -1,0 +1,225 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+
+All pools are XLA reduce_window calls (the TPU analogue of the reference's
+cuDNN pooling descriptors, paddle/phi/kernels/gpudnn/pool_kernel.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import wrap_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else v * n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return [(int(v), int(v)) for v in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _reduce_window(x, init, op, ksize, stride, pad, n, channel_last):
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        pad_cfg = ([(0, 0)] + pad + [(0, 0)]) if isinstance(pad, list) else pad
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+        pad_cfg = ([(0, 0), (0, 0)] + pad) if isinstance(pad, list) else pad
+    if isinstance(pad_cfg, str):
+        pad_cfg = jax.lax.padtype_to_pads(x.shape, dims, strides, pad_cfg)
+    return jax.lax.reduce_window(x, init, op, dims, strides, pad_cfg)
+
+
+@wrap_op
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride if stride is not None else kernel_size, 2)
+    pad = _pool_pad(padding, 2)
+    cl = data_format == "NHWC"
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    out = _reduce_window(x, neg_inf, jax.lax.max, ks, st, pad, 2, cl)
+    if return_mask:
+        idx = _pool_argmax(x, ks, st, pad, cl)
+        return out, idx
+    return out
+
+
+def _pool_argmax(x, ks, st, pad, channel_last):
+    # argmax indices within each window, flattened over H*W (paddle semantics)
+    assert not channel_last
+    n, c, h, w = x.shape
+    lin = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    lin = jnp.broadcast_to(lin, x.shape)
+    # select index of max via reduce_window over (value, index) pairs
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0))
+    vals, idx = jax.lax.reduce_window(
+        (x, lin), init, reducer,
+        (1, 1) + ks, (1, 1) + st,
+        [(0, 0), (0, 0)] + pad if isinstance(pad, list) else pad)
+    return idx.astype(jnp.int64)
+
+
+@wrap_op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride if stride is not None else kernel_size, 2)
+    pad = _pool_pad(padding, 2)
+    cl = data_format == "NHWC"
+    summed = _reduce_window(x, 0.0, jax.lax.add, ks, st, pad, 2, cl)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive and pad not in ("VALID",):
+        ones = jnp.ones_like(x)
+        counts = _reduce_window(ones, 0.0, jax.lax.add, ks, st, pad, 2, cl)
+        return summed / counts
+    return summed / float(np.prod(ks))
+
+
+@wrap_op
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride if stride is not None else kernel_size, 1)
+    pad = _pool_pad(padding, 1)
+    neg_inf = -jnp.inf
+    out = jax.lax.reduce_window(x, neg_inf, jax.lax.max, (1, 1) + ks,
+                                (1, 1) + st, [(0, 0), (0, 0)] + pad)
+    return out
+
+
+@wrap_op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride if stride is not None else kernel_size, 1)
+    pad = _pool_pad(padding, 1)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1) + ks,
+                                   (1, 1) + st, [(0, 0), (0, 0)] + pad)
+    if exclusive:
+        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                       (1, 1) + ks, (1, 1) + st,
+                                       [(0, 0), (0, 0)] + pad)
+        return summed / counts
+    return summed / float(ks[0])
+
+
+@wrap_op
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride if stride is not None else kernel_size, 3)
+    pad = _pool_pad(padding, 3)
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1) + ks,
+                                 (1, 1) + st, [(0, 0), (0, 0)] + pad)
+
+
+@wrap_op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW"):
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride if stride is not None else kernel_size, 3)
+    pad = _pool_pad(padding, 3)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1) + ks,
+                                   (1, 1) + st, [(0, 0), (0, 0)] + pad)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                       (1, 1) + ks, (1, 1) + st,
+                                       [(0, 0), (0, 0)] + pad)
+        return summed / counts
+    return summed / float(np.prod(ks))
+
+
+def _adaptive_windows(in_size, out_size):
+    # start/end per output bin, paddle/torch adaptive pooling semantics
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * in_size) // out_size)
+    return starts, ends
+
+
+@wrap_op
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    os = _tuple(output_size, 2)
+    h, w = x.shape[-2:]
+    if h % os[0] == 0 and w % os[1] == 0:
+        # uniform windows — single reduce_window
+        ks = (h // os[0], w // os[1])
+        return jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1) + ks,
+                                     (1, 1) + ks, "VALID") / float(np.prod(ks))
+    hs, he = _adaptive_windows(h, os[0])
+    ws, we = _adaptive_windows(w, os[1])
+    rows = [jnp.mean(x[..., s:e, :], axis=-2, keepdims=True) for s, e in zip(hs, he)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.mean(xh[..., :, s:e], axis=-1, keepdims=True) for s, e in zip(ws, we)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@wrap_op
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    os = _tuple(output_size, 2)
+    h, w = x.shape[-2:]
+    if h % os[0] == 0 and w % os[1] == 0:
+        ks = (h // os[0], w // os[1])
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1) + ks,
+                                     (1, 1) + ks, "VALID")
+    hs, he = _adaptive_windows(h, os[0])
+    ws, we = _adaptive_windows(w, os[1])
+    rows = [jnp.max(x[..., s:e, :], axis=-2, keepdims=True) for s, e in zip(hs, he)]
+    xh = jnp.concatenate(rows, axis=-2)
+    cols = [jnp.max(xh[..., :, s:e], axis=-1, keepdims=True) for s, e in zip(ws, we)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+@wrap_op
+def adaptive_avg_pool1d(x, output_size):
+    l = x.shape[-1]
+    os = int(output_size)
+    if l % os == 0:
+        k = l // os
+        return jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, k),
+                                     (1, 1, k), "VALID") / float(k)
+    ss, es = _adaptive_windows(l, os)
+    return jnp.concatenate([jnp.mean(x[..., s:e], axis=-1, keepdims=True)
+                            for s, e in zip(ss, es)], axis=-1)
+
+
+@wrap_op
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    l = x.shape[-1]
+    os = int(output_size)
+    ss, es = _adaptive_windows(l, os)
+    return jnp.concatenate([jnp.max(x[..., s:e], axis=-1, keepdims=True)
+                            for s, e in zip(ss, es)], axis=-1)
+
+
+@wrap_op
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    os = _tuple(output_size, 3)
+    d, h, w = x.shape[-3:]
+    if d % os[0] == 0 and h % os[1] == 0 and w % os[2] == 0:
+        ks = (d // os[0], h // os[1], w // os[2])
+        return jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1) + ks,
+                                     (1, 1) + ks, "VALID") / float(np.prod(ks))
+    raise NotImplementedError("non-divisible adaptive_avg_pool3d")

@@ -1,0 +1,181 @@
+"""Weight initializers (reference surface: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from
+the global PRNG stream; also usable as the ``default_initializer`` of
+``Layer.create_parameter``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _rnd
+from ...core import dtype as _dt
+
+
+def _fan(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle convention OIHW for Conv2D weight (out, in, kh, kw)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(shape, self.value,
+                        _dt.convert_dtype(dtype) or _dt.get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        return (jax.random.normal(_rnd.next_key(), shape, dtype) * self.std
+                + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        return (jax.random.truncated_normal(_rnd.next_key(), -2.0, 2.0, shape,
+                                            dtype) * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        return jax.random.uniform(_rnd.next_key(), shape, dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        fi, fo = _fan(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(_rnd.next_key(), shape, dtype) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        fi, fo = _fan(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_rnd.next_key(), shape, dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        fi, _ = _fan(shape)
+        fi = self.fan_in or fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity in ("relu", "leaky_relu") else 1.0)
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(_rnd.next_key(), shape, dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        fi, _ = _fan(shape)
+        fi = self.fan_in or fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity in ("relu", "leaky_relu") else 1.0)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_rnd.next_key(), shape, dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        return jax.nn.initializers.orthogonal(scale=self.gain)(
+            _rnd.next_key(), shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ...core.tensor import Tensor
+        v = self.value._array if isinstance(self.value, Tensor) else np.asarray(self.value)
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        return jnp.asarray(v, dtype).reshape(shape)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        out = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic * self.groups)):
+            idx = (i, i % ic) + tuple(centers)
+            out[idx] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,347 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: each layer-direction is ONE ``lax.scan`` over time —
+compiler-friendly static control flow (the reference runs per-step cuDNN
+kernels / a C++ while-op instead).  The whole multi-layer stack is a single
+traced op, so grads flow through scan's native VJP.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...core.dispatch import call
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _lstm_step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+    h, c = carry
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+    h = carry
+    xr, xz, xn = jnp.split(x_t @ w_ih.T + (b_ih if b_ih is not None else 0.0), 3, axis=-1)
+    hr, hz, hn = jnp.split(h @ w_hh.T + (b_hh if b_hh is not None else 0.0), 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h = (1.0 - z) * n + z * h
+    return h, h
+
+
+def _rnn_step(carry, x_t, w_ih, w_hh, b_ih, b_hh, act):
+    h = carry
+    out = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        out = out + b_ih + b_hh
+    h = jnp.tanh(out) if act == "tanh" else jax.nn.relu(out)
+    return h, h
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return ops.full([b, self.hidden_size], init_value,
+                        dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               default_initializer=u)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((hidden_size,), is_bias=True,
+                                              default_initializer=u))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((hidden_size,), is_bias=True,
+                                              default_initializer=u))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def raw(x, h, wi, wh, bi, bh):
+            new_h, _ = _rnn_step(h, x, wi, wh, bi, bh, self.activation)
+            return new_h
+        h = call(raw, inputs, states, self.weight_ih, self.weight_hh,
+                 self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               default_initializer=u)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((4 * hidden_size,), is_bias=True,
+                                              default_initializer=u))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((4 * hidden_size,), is_bias=True,
+                                              default_initializer=u))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+            states = (h, c)
+        def raw(x, h, c, wi, wh, bi, bh):
+            (nh, nc), _ = _lstm_step((h, c), x, wi, wh, bi, bh)
+            return nh, nc
+        h, c = call(raw, inputs, states[0], states[1], self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               default_initializer=u)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((3 * hidden_size,), is_bias=True,
+                                              default_initializer=u))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((3 * hidden_size,), is_bias=True,
+                                              default_initializer=u))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def raw(x, h, wi, wh, bi, bh):
+            nh, _ = _gru_step(h, x, wi, wh, bi, bh)
+            return nh
+        h = call(raw, inputs, states, self.weight_ih, self.weight_hh,
+                 self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over time (reference: nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        outputs = []
+        states = initial_states
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in rng:
+            x_t = ops.getitem(inputs, (slice(None), t) if t_axis == 1 else t)
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = ops.stack(outputs, axis=t_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw)
+        out = ops.concat([out_fw, out_bw], axis=-1)
+        return out, (fw_states, bw_states)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) scan-based recurrent stack."""
+
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation="tanh", name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = (input_size if layer == 0
+                         else hidden_size * self.num_directions)
+                suffix = f"l{layer}" + ("_reverse" if d == 1 else "")
+                w_ih = self.create_parameter((gate_mult * hidden_size, in_sz),
+                                             default_initializer=u)
+                w_hh = self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                             default_initializer=u)
+                b_ih = self.create_parameter((gate_mult * hidden_size,),
+                                             is_bias=True, default_initializer=u)
+                b_hh = self.create_parameter((gate_mult * hidden_size,),
+                                             is_bias=True, default_initializer=u)
+                self.add_parameter(f"weight_ih_{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_{suffix}", b_hh)
+                self._weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        act = self.activation
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        dropout = self.dropout if self.training else 0.0
+        from ...core import random as _rnd
+        drop_key = _rnd.next_key() if dropout > 0 else None
+
+        def raw(x, h0, c0, *flat_w):
+            # x: (B, T, I) if not time_major else (T, B, I)
+            if time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            B = x.shape[0]
+            ws = [flat_w[i * 4:(i + 1) * 4] for i in range(nl * nd)]
+            h_out, c_out = [], []
+            layer_in = x
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    w_ih, w_hh, b_ih, b_hh = ws[layer * nd + d]
+                    idx = layer * nd + d
+                    h_init = h0[idx]
+                    seq = jnp.swapaxes(layer_in, 0, 1)  # (T, B, I)
+                    if d == 1:
+                        seq = jnp.flip(seq, 0)
+                    if mode == "LSTM":
+                        c_init = c0[idx]
+                        def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                            return _lstm_step(carry, x_t, w_ih, w_hh, b_ih, b_hh)
+                        (h_f, c_f), outs = jax.lax.scan(step, (h_init, c_init), seq)
+                        c_out.append(c_f)
+                    elif mode == "GRU":
+                        def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                            return _gru_step(carry, x_t, w_ih, w_hh, b_ih, b_hh)
+                        h_f, outs = jax.lax.scan(step, h_init, seq)
+                    else:
+                        def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                            return _rnn_step(carry, x_t, w_ih, w_hh, b_ih, b_hh, act)
+                        h_f, outs = jax.lax.scan(step, h_init, seq)
+                    h_out.append(h_f)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(jnp.swapaxes(outs, 0, 1))  # (B, T, H)
+                layer_in = (dir_outs[0] if nd == 1
+                            else jnp.concatenate(dir_outs, axis=-1))
+                if dropout > 0 and layer < nl - 1:
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(drop_key, layer), 1.0 - dropout,
+                        layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
+            out = layer_in
+            if time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(h_out, 0)
+            if mode == "LSTM":
+                return out, h_stack, jnp.stack(c_out, 0)
+            return out, h_stack
+
+        B = inputs.shape[1] if time_major else inputs.shape[0]
+        if initial_states is None:
+            zeros = ops.zeros([nl * nd, B, hs], inputs.dtype)
+            if mode == "LSTM":
+                initial_states = (zeros, ops.zeros([nl * nd, B, hs], inputs.dtype))
+            else:
+                initial_states = zeros
+        flat_w = [w for tup in self._weights for w in tup]
+        if mode == "LSTM":
+            h0, c0 = initial_states
+            out, h, c = call(raw, inputs, h0, c0, *flat_w, name=f"{mode}_stack")
+            return out, (h, c)
+        h0 = initial_states
+        out, h = call(lambda x, h0_, *w: raw(x, h0_, None, *w), inputs, h0,
+                      *flat_w, name=f"{mode}_stack")
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,44 @@
+"""DataParallel wrapper (reference: python/paddle/fluid/dygraph/parallel.py:413).
+
+TPU-native: under jax's single-controller model, data parallelism is a
+sharding of the batch axis over the mesh — gradients come back globally
+summed by XLA (the reference needed an EagerReducer with bucketed NCCL
+allreduce; SURVEY.md §2.2 row 1).  This wrapper therefore:
+
+* eager path: runs the inner layer unchanged on one device (single-process
+  semantics identical to reference single-rank), and
+* compiled path: ``paddle_tpu.jit.TrainStep`` / ``distributed.parallelize``
+  shard the batch axis of its inputs over the 'dp' mesh axis.
+"""
+from __future__ import annotations
+
+from .layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, *a, **k):
+        return self._layers.set_state_dict(state_dict, *a, **k)
+
+    def scale_loss(self, loss):
+        # XLA handles gradient averaging via mean-over-batch + psum; no-op
+        return loss
+
+    def apply_collective_grads(self):
+        # grads are already globally reduced on the compiled path; on the
+        # eager single-process path there is nothing to reduce
+        pass

@@ -1,0 +1,18 @@
+"""Functional tensor-op surface (the analogue of python/paddle/tensor/)."""
+from ..core.tensor import to_tensor  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .creation import (arange, assign, clone, diag, empty, empty_like, eye,
+                       full, full_like, linspace, meshgrid, one_hot, ones,
+                       ones_like, tril, triu, zeros, zeros_like)
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import (bernoulli, binomial, gaussian, multinomial, normal,
+                         poisson, rand, randint, randint_like, randn, randperm,
+                         standard_normal, uniform)
+from . import methods as _methods
+
+_methods.install()

@@ -1,0 +1,49 @@
+"""Comparison / logical / bitwise ops
+(reference surface: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import wrap_op
+from ..core.tensor import Tensor
+
+equal = wrap_op(jnp.equal, name="equal")
+not_equal = wrap_op(jnp.not_equal, name="not_equal")
+greater_than = wrap_op(jnp.greater, name="greater_than")
+greater_equal = wrap_op(jnp.greater_equal, name="greater_equal")
+less_than = wrap_op(jnp.less, name="less_than")
+less_equal = wrap_op(jnp.less_equal, name="less_equal")
+
+logical_and = wrap_op(jnp.logical_and, name="logical_and")
+logical_or = wrap_op(jnp.logical_or, name="logical_or")
+logical_xor = wrap_op(jnp.logical_xor, name="logical_xor")
+logical_not = wrap_op(jnp.logical_not, name="logical_not")
+
+bitwise_and = wrap_op(jnp.bitwise_and, name="bitwise_and")
+bitwise_or = wrap_op(jnp.bitwise_or, name="bitwise_or")
+bitwise_xor = wrap_op(jnp.bitwise_xor, name="bitwise_xor")
+bitwise_not = wrap_op(jnp.bitwise_not, name="bitwise_not")
+bitwise_left_shift = wrap_op(jnp.left_shift, name="bitwise_left_shift")
+bitwise_right_shift = wrap_op(jnp.right_shift, name="bitwise_right_shift")
+
+isclose = wrap_op(lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+                  jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                  name="isclose")
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return Tensor(jnp.allclose(
+        x._array if isinstance(x, Tensor) else x,
+        y._array if isinstance(y, Tensor) else y,
+        rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    return Tensor(jnp.array_equal(
+        x._array if isinstance(x, Tensor) else x,
+        y._array if isinstance(y, Tensor) else y))
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))

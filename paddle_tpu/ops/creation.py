@@ -1,0 +1,195 @@
+"""Tensor creation ops (reference surface: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.dispatch import call, wrap_op
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+
+
+def _d(dtype):
+    return _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._array
+    return Tensor(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._array))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._array) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@wrap_op
+def _zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt.convert_dtype(dtype))
+
+
+@wrap_op
+def _ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt.convert_dtype(dtype))
+
+
+@wrap_op
+def _full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return _zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None):
+    return _ones_like(x, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None):
+    return _full_like(x, fill_value, dtype=dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step))
+                 else _dt.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=_d(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+@wrap_op
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@wrap_op
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(_d(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(_d(dtype)))
+
+
+@wrap_op
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, x.dtype))
+        return out
+    return jnp.diag(x, k=offset)
+
+
+@wrap_op
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@wrap_op
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    m = n + abs(offset)
+    idx = jnp.arange(n)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    if dim1 != -2 or dim2 != -1:
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [a._array if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+@wrap_op
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return call(lambda a: a + 0 if _dt.is_inexact(a.dtype) else jnp.array(a), x, name="clone")
+
+
+@wrap_op
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@wrap_op
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@wrap_op
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@wrap_op
+def real(x):
+    return jnp.real(x)
+
+
+@wrap_op
+def imag(x):
+    return jnp.imag(x)
+
+
+def one_hot(x, num_classes):
+    arr = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(arr, num_classes, dtype=_dt.get_default_dtype()))

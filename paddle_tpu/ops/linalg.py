@@ -1,0 +1,131 @@
+"""Linear algebra (reference surface: python/paddle/tensor/linalg.py; matmul
+parity with reference paddle.matmul at linalg.py:124).
+
+All matmuls lower to XLA dot_general on the MXU; keep operands bf16 under the
+amp policy for peak throughput.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import wrap_op
+
+
+@wrap_op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+bmm = wrap_op(jnp.matmul, name="bmm")
+dot = wrap_op(lambda x, y: jnp.sum(x * y, axis=-1), name="dot")
+mv = wrap_op(jnp.matmul, name="mv")
+tensordot = wrap_op(lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes), name="tensordot")
+einsum_raw = jnp.einsum
+
+
+@wrap_op
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@wrap_op
+def t(x):
+    if x.ndim < 2:
+        return x
+    if x.ndim == 2:
+        return x.T
+    raise ValueError("paddle.t only supports ndim<=2; use transpose")
+
+
+@wrap_op
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@wrap_op
+def norm(x, p="fro", axis=None, keepdim=False):
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro",
+                               axis=tuple(axis), keepdims=keepdim)
+    if p == "fro" or p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@wrap_op
+def dist(x, y, p=2):
+    d = x - y
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+cross = wrap_op(lambda x, y, axis=None: jnp.cross(x, y, axis=-1 if axis is None else axis), name="cross")
+cholesky = wrap_op(lambda x, upper=False: jnp.linalg.cholesky(x) if not upper
+                   else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2).conj(), name="cholesky")
+inverse = wrap_op(jnp.linalg.inv, name="inverse")
+pinv = wrap_op(lambda x, rcond=1e-15, hermitian=False: jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian), name="pinv")
+matrix_power = wrap_op(jnp.linalg.matrix_power, name="matrix_power")
+slogdet = wrap_op(lambda x: tuple(jnp.linalg.slogdet(x)), name="slogdet")
+det = wrap_op(jnp.linalg.det, name="det")
+solve = wrap_op(jnp.linalg.solve, name="solve")
+lstsq = wrap_op(lambda x, y, rcond=None: tuple(jnp.linalg.lstsq(x, y, rcond=rcond)), name="lstsq")
+qr = wrap_op(lambda x, mode="reduced": tuple(jnp.linalg.qr(x, mode=mode)), name="qr")
+svd = wrap_op(lambda x, full_matrices=False: tuple(jnp.linalg.svd(x, full_matrices=full_matrices)), name="svd")
+eig = wrap_op(lambda x: tuple(jnp.linalg.eig(x)), name="eig")
+eigh = wrap_op(lambda x, UPLO="L": tuple(jnp.linalg.eigh(x, UPLO=UPLO)), name="eigh")
+eigvals = wrap_op(jnp.linalg.eigvals, name="eigvals")
+eigvalsh = wrap_op(jnp.linalg.eigvalsh, name="eigvalsh")
+matrix_rank = wrap_op(lambda x, tol=None, hermitian=False: jnp.linalg.matrix_rank(x, rtol=tol), name="matrix_rank")
+multi_dot = wrap_op(lambda xs: jnp.linalg.multi_dot(xs), name="multi_dot")
+cond = wrap_op(lambda x, p=None: jnp.linalg.cond(x, p=p), name="cond")
+trace = wrap_op(lambda x, offset=0, axis1=0, axis2=1: jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2), name="trace")
+triangular_solve = wrap_op(
+    lambda x, y, upper=True, transpose=False, unitriangular=False:
+    jax.scipy.linalg.solve_triangular(x, y, lower=not upper, trans=1 if transpose else 0,
+                                      unit_diagonal=unitriangular),
+    name="triangular_solve")
+cholesky_solve = wrap_op(
+    lambda x, y, upper=False: jax.scipy.linalg.cho_solve((y, not upper), x),
+    name="cholesky_solve")
+lu = wrap_op(lambda x: tuple(jax.scipy.linalg.lu(x, permute_l=False)), name="lu")
+corrcoef = wrap_op(lambda x, rowvar=True: jnp.corrcoef(x, rowvar=rowvar), name="corrcoef")
+cov = wrap_op(lambda x, rowvar=True, ddof=True, fweights=None, aweights=None:
+              jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                      fweights=fweights, aweights=aweights), name="cov")
+
+
+@wrap_op
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+@wrap_op
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)

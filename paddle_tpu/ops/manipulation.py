@@ -1,0 +1,356 @@
+"""Shape / layout / indexing ops
+(reference surface: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.dispatch import call, wrap_op
+from ..core.tensor import Tensor
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._array))
+    return tuple(int(s._array) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape):
+    shape = _static_shape(shape)
+    return call(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+view = reshape
+
+
+@wrap_op
+def cast(x, dtype):
+    return x.astype(_dt.convert_dtype(dtype))
+
+
+def transpose(x, perm):
+    perm = tuple(int(p) for p in perm)
+    return call(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+@wrap_op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape((1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@wrap_op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@wrap_op
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+def concat(x, axis=0):
+    axis = int(axis) if not isinstance(axis, Tensor) else int(axis._array)
+    return call(lambda arrs: jnp.concatenate(arrs, axis=axis), list(x), name="concat")
+
+
+def stack(x, axis=0):
+    return call(lambda arrs: jnp.stack(arrs, axis=axis), list(x), name="stack")
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis) if not isinstance(axis, Tensor) else int(axis._array)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        return list(call(lambda a: tuple(jnp.split(a, n, axis=axis)), x, name="split"))
+    sections = [int(s._array) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+    dim = None
+    # allow one -1 section
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        total = x.shape[axis]
+        sections = [s if s != -1 else total - known for s in sections]
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return list(call(lambda a: tuple(jnp.split(a, offsets, axis=axis)), x, name="split"))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return list(call(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                     x, name="unbind"))
+
+
+unstack = unbind
+
+
+@wrap_op
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+def expand(x, shape):
+    shape = _static_shape(shape)
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+                  for i, s in enumerate(shape))
+    return call(lambda a: jnp.broadcast_to(a, shape), x, name="expand")
+
+
+def expand_as(x, y):
+    return expand(x, y.shape)
+
+
+broadcast_to = expand
+
+
+def broadcast_tensors(inputs):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(t, out_shape) for t in inputs]
+
+
+@wrap_op
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@wrap_op
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@wrap_op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@wrap_op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@wrap_op
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+# -- gather / scatter family -------------------------------------------------
+
+
+@wrap_op
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@wrap_op
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@wrap_op
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle: non-overwrite zeroes target rows then adds
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@wrap_op
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@wrap_op
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(tuple(shape), updates.dtype)
+    return zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@wrap_op
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+@wrap_op
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@wrap_op
+def index_add(x, index, axis, value):
+    return jnp.apply_along_axis  # placeholder; replaced below
+
+
+@wrap_op
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@wrap_op
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    idx = tuple(jnp.broadcast_to(i, indices.shape) for i in idx)
+    if reduce == "add":
+        return x.at[idx].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+@wrap_op
+def masked_select(x, mask):
+    # dynamic output shape — eager only (same restriction as reference to_static)
+    return x[mask]
+
+
+@wrap_op
+def masked_fill(x, mask, value):
+    v = value if not hasattr(value, "shape") else value
+    return jnp.where(mask, jnp.asarray(v, x.dtype), x)
+
+
+@wrap_op
+def fill_diagonal(x, value, offset=0, wrap=False):
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n - abs(offset) if offset else n)
+    if offset >= 0:
+        return x.at[..., idx, idx + offset].set(value)
+    return x.at[..., idx - offset, idx].set(value)
+
+
+@wrap_op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@wrap_op
+def slice(x, axes, starts, ends):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = jnp.s_[int(st):int(en)]
+    return x[tuple(slices)]
+
+
+@wrap_op
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = jnp.s_[int(st):int(en):int(sd)]
+    return x[tuple(slices)]
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    return call(
+        lambda a: jnp.where(
+            (a // shard_size) == shard_id, a % shard_size, ignore_value),
+        x, name="shard_index")
+
+
+@wrap_op
+def crop(x, shape, offsets=None):
+    if offsets is None:
+        offsets = [0] * x.ndim
+    slices = tuple(jnp.s_[int(o):int(o) + int(s)] for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def numel(x):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if all(isinstance(s, int) for s in x.shape) else x._array.size, jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+@wrap_op
+def unfold(x, kernel_size, strides=1, paddings=0, dilations=1):
+    # im2col over NCHW — XLA pattern: extract patches via conv_general_dilated_patches
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(ks), window_strides=tuple(st),
+        padding=[(pd[0], pd[1]), (pd[2], pd[3])],
+        rhs_dilation=tuple(dl), dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+# -- python-level indexing ----------------------------------------------------
+
+def getitem(x, idx):
+    return call(lambda a, i: a[i], x, _normalize_index(idx), name="getitem")
+
+
+def _normalize_index(idx):
+    # tuples flatten fine through the dispatch pytree walk; Tensors inside get
+    # unwrapped to arrays automatically
+    return idx
+
+
+def setitem(x, idx, value):
+    from ..core.dispatch import assign_inplace, shadow
+    out = call(lambda a, i, v: a.at[i].set(v), shadow(x),
+               _normalize_index(idx), value, name="setitem")
+    return assign_inplace(x, out)
+
+
+# fix placeholder
+def index_add(x, index, axis, value):  # noqa: F811
+    return call(lambda a, i, v: a.at[tuple(
+        jnp.s_[:] if d != axis else i for d in range(a.ndim))].add(v),
+        x, index, value, name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False):
+    def raw(a, idx_t, v):
+        idx_t = tuple(idx_t)
+        if accumulate:
+            return a.at[idx_t].add(v)
+        return a.at[idx_t].set(v)
+    return call(raw, x, tuple(indices), value, name="index_put")
+
+
+def as_strided(x, shape, stride, offset=0):
+    def raw(a):
+        flat = a.reshape(-1)[offset:]
+        idx = np.zeros(tuple(shape), dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            rng = np.arange(s) * st
+            idx = idx + rng.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[idx]
+    return call(raw, x, name="as_strided")

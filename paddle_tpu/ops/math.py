@@ -1,0 +1,211 @@
+"""Elementwise & scalar math ops (reference surface: python/paddle/tensor/math.py).
+
+Every op is a raw jax function wrapped for eager-tape dispatch; under a jit
+trace the same functions run tape-free.  XLA fuses these elementwise chains
+into surrounding matmuls/reductions — no hand-written fusion needed (the
+analogue of the reference's elementwise CUDA kernel family,
+paddle/phi/kernels/gpu/elementwise*.cu, comes free from the compiler).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import wrap_op
+from ..core.tensor import Tensor
+
+# -- binary ------------------------------------------------------------------
+
+add = wrap_op(jnp.add, name="add")
+subtract = wrap_op(jnp.subtract, name="subtract")
+multiply = wrap_op(jnp.multiply, name="multiply")
+divide = wrap_op(jnp.divide, name="divide")
+mod = wrap_op(jnp.mod, name="mod")
+remainder = mod
+floor_mod = mod
+floor_divide = wrap_op(jnp.floor_divide, name="floor_divide")
+pow = wrap_op(jnp.power, name="pow")
+maximum = wrap_op(jnp.maximum, name="maximum")
+minimum = wrap_op(jnp.minimum, name="minimum")
+fmax = wrap_op(jnp.fmax, name="fmax")
+fmin = wrap_op(jnp.fmin, name="fmin")
+atan2 = wrap_op(jnp.arctan2, name="atan2")
+hypot = wrap_op(jnp.hypot, name="hypot")
+gcd = wrap_op(jnp.gcd, name="gcd")
+lcm = wrap_op(jnp.lcm, name="lcm")
+heaviside = wrap_op(jnp.heaviside, name="heaviside")
+copysign = wrap_op(jnp.copysign, name="copysign")
+nextafter = wrap_op(jnp.nextafter, name="nextafter")
+ldexp = wrap_op(jnp.ldexp, name="ldexp")
+logaddexp = wrap_op(jnp.logaddexp, name="logaddexp")
+inner = wrap_op(jnp.inner, name="inner")
+outer = wrap_op(jnp.outer, name="outer")
+kron = wrap_op(jnp.kron, name="kron")
+
+
+@wrap_op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    scale = jnp.asarray(scale, x.dtype) if not hasattr(scale, "dtype") else scale.astype(x.dtype)
+    if bias_after_scale:
+        return x * scale + jnp.asarray(bias, x.dtype)
+    return (x + jnp.asarray(bias, x.dtype)) * scale
+
+
+divide_ = divide
+
+# -- unary -------------------------------------------------------------------
+
+exp = wrap_op(jnp.exp, name="exp")
+expm1 = wrap_op(jnp.expm1, name="expm1")
+log = wrap_op(jnp.log, name="log")
+log2 = wrap_op(jnp.log2, name="log2")
+log10 = wrap_op(jnp.log10, name="log10")
+log1p = wrap_op(jnp.log1p, name="log1p")
+sqrt = wrap_op(jnp.sqrt, name="sqrt")
+rsqrt = wrap_op(jax.lax.rsqrt, name="rsqrt")
+abs = wrap_op(jnp.abs, name="abs")
+neg = wrap_op(jnp.negative, name="neg")
+sign = wrap_op(jnp.sign, name="sign")
+sgn = sign
+reciprocal = wrap_op(jnp.reciprocal, name="reciprocal")
+square = wrap_op(jnp.square, name="square")
+floor = wrap_op(jnp.floor, name="floor")
+ceil = wrap_op(jnp.ceil, name="ceil")
+round = wrap_op(jnp.round, name="round")
+trunc = wrap_op(jnp.trunc, name="trunc")
+frac = wrap_op(lambda x: x - jnp.trunc(x), name="frac")
+sin = wrap_op(jnp.sin, name="sin")
+cos = wrap_op(jnp.cos, name="cos")
+tan = wrap_op(jnp.tan, name="tan")
+asin = wrap_op(jnp.arcsin, name="asin")
+acos = wrap_op(jnp.arccos, name="acos")
+atan = wrap_op(jnp.arctan, name="atan")
+sinh = wrap_op(jnp.sinh, name="sinh")
+cosh = wrap_op(jnp.cosh, name="cosh")
+tanh = wrap_op(jnp.tanh, name="tanh")
+asinh = wrap_op(jnp.arcsinh, name="asinh")
+acosh = wrap_op(jnp.arccosh, name="acosh")
+atanh = wrap_op(jnp.arctanh, name="atanh")
+erf = wrap_op(jax.lax.erf, name="erf")
+erfinv = wrap_op(jax.lax.erf_inv, name="erfinv")
+sigmoid = wrap_op(jax.nn.sigmoid, name="sigmoid")
+digamma = wrap_op(jax.scipy.special.digamma, name="digamma")
+lgamma = wrap_op(jax.scipy.special.gammaln, name="lgamma")
+gamma = wrap_op(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), name="gamma")
+i0 = wrap_op(jax.scipy.special.i0, name="i0")
+i1 = wrap_op(jax.scipy.special.i1, name="i1")
+rad2deg = wrap_op(jnp.rad2deg, name="rad2deg")
+deg2rad = wrap_op(jnp.deg2rad, name="deg2rad")
+angle = wrap_op(jnp.angle, name="angle")
+conj = wrap_op(jnp.conj, name="conj")
+exponent = wrap_op(lambda x: jnp.frexp(x)[1].astype(jnp.int32), name="exponent")
+
+
+@wrap_op
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@wrap_op
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@wrap_op
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+isnan = wrap_op(jnp.isnan, name="isnan")
+isinf = wrap_op(jnp.isinf, name="isinf")
+isfinite = wrap_op(jnp.isfinite, name="isfinite")
+isreal = wrap_op(jnp.isreal, name="isreal")
+
+# -- scan-style --------------------------------------------------------------
+
+
+@wrap_op
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@wrap_op
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@wrap_op
+def cummax_values(x, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.flatten()
+        axis = 0
+    vals = cummax_values(x, axis)
+    from . import comparison, search
+    idx = search._running_argextreme(x, axis, True)
+    return vals, idx
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.flatten()
+        axis = 0
+    vals = wrap_op(lambda a: jax.lax.cummin(a, axis=axis), name="cummin")(x)
+    from . import search
+    idx = search._running_argextreme(x, axis, False)
+    return vals, idx
+
+
+@wrap_op
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@wrap_op
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@wrap_op
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None and x is None:
+        dx = 1.0
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)
+
+
+# -- misc --------------------------------------------------------------------
+
+
+@wrap_op
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@wrap_op
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def increment(x, value=1.0):
+    x._array = x._array + jnp.asarray(value, x._array.dtype)
+    return x
+
+
+@wrap_op
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@wrap_op
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)

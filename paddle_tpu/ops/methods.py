@@ -1,0 +1,138 @@
+"""Attach operator dunders and paddle-style methods to Tensor.
+
+The reference patches methods onto its eager Tensor via
+monkey_patch_varbase/monkey_patch_tensor
+(reference: python/paddle/fluid/dygraph/varbase_patch_methods.py); we do the
+same, binding the functional ops as methods at import time.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import (comparison, creation, linalg, manipulation, math, random_ops,
+               reduction, search)
+
+
+def _binary(op, swap=False):
+    def method(self, other):
+        if swap:
+            return op(other, self)
+        return op(self, other)
+    return method
+
+
+def install():
+    T = Tensor
+    # arithmetic
+    T.__add__ = _binary(math.add)
+    T.__radd__ = _binary(math.add, swap=True)
+    T.__sub__ = _binary(math.subtract)
+    T.__rsub__ = _binary(math.subtract, swap=True)
+    T.__mul__ = _binary(math.multiply)
+    T.__rmul__ = _binary(math.multiply, swap=True)
+    T.__truediv__ = _binary(math.divide)
+    T.__rtruediv__ = _binary(math.divide, swap=True)
+    T.__floordiv__ = _binary(math.floor_divide)
+    T.__rfloordiv__ = _binary(math.floor_divide, swap=True)
+    T.__mod__ = _binary(math.mod)
+    T.__rmod__ = _binary(math.mod, swap=True)
+    T.__pow__ = _binary(math.pow)
+    T.__rpow__ = _binary(math.pow, swap=True)
+    T.__matmul__ = _binary(linalg.matmul)
+    T.__rmatmul__ = _binary(linalg.matmul, swap=True)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: (comparison.logical_not(self)
+                                 if self.dtype == bool else comparison.bitwise_not(self))
+    # comparisons
+    T.__eq__ = _binary(comparison.equal)
+    T.__ne__ = _binary(comparison.not_equal)
+    T.__lt__ = _binary(comparison.less_than)
+    T.__le__ = _binary(comparison.less_equal)
+    T.__gt__ = _binary(comparison.greater_than)
+    T.__ge__ = _binary(comparison.greater_equal)
+    # bitwise / logical
+    T.__and__ = _binary(comparison.bitwise_and)
+    T.__or__ = _binary(comparison.bitwise_or)
+    T.__xor__ = _binary(comparison.bitwise_xor)
+    # indexing
+    T.__getitem__ = manipulation.getitem
+    T.__setitem__ = manipulation.setitem
+
+    mods = (math, reduction, linalg, manipulation, comparison, search)
+    skip = {"where", "Tensor", "wrap_op", "call", "getitem", "setitem",
+            "shape", "numel", "nonzero", "unique", "unique_consecutive"}
+    for mod in mods:
+        for name in dir(mod):
+            if name.startswith("_") or name in skip:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if not hasattr(T, name):
+                setattr(T, name, fn)
+
+    # a few extras / renames
+    T.matmul = linalg.matmul
+    T.mm = linalg.matmul
+    T.dot = linalg.dot
+    T.reshape = manipulation.reshape
+    T.reshape_ = lambda self, shape: _inplace(self, manipulation.reshape, shape)
+    T.nonzero = search.nonzero
+    T.unique = search.unique
+    T.transpose = manipulation.transpose
+    T.flatten = manipulation.flatten
+    T.squeeze = manipulation.squeeze
+    T.unsqueeze = manipulation.unsqueeze
+    T.sum = reduction.sum
+    T.mean = reduction.mean
+    T.max = reduction.max
+    T.min = reduction.min
+    T.prod = reduction.prod
+    T.std = reduction.std
+    T.var = reduction.var
+    T.all = reduction.all
+    T.any = reduction.any
+    T.argmax = search.argmax
+    T.argmin = search.argmin
+    T.argsort = search.argsort
+    T.sort = search.sort
+    T.topk = search.topk
+    T.where = lambda self, x, y: search.where(self, x, y)
+    T.clip = math.clip
+    T.clip_ = lambda self, min=None, max=None: _inplace(self, math.clip, min, max)
+    T.add_ = lambda self, y: _inplace(self, math.add, y)
+    T.subtract_ = lambda self, y: _inplace(self, math.subtract, y)
+    T.multiply_ = lambda self, y: _inplace(self, math.multiply, y)
+    T.scale_ = lambda self, s, bias=0.0: _inplace(self, math.scale, s, bias)
+    T.zero_ = lambda self: _inplace(self, creation.zeros_like)
+    T.fill_ = lambda self, v: _inplace(self, creation.full_like, v)
+    T.exp_ = lambda self: _inplace(self, math.exp)
+    T.uniform_ = lambda self, min=-1.0, max=1.0, seed=0: _assign(
+        self, random_ops.uniform(self.shape, self.dtype, min, max, seed))
+    T.normal_ = lambda self, mean=0.0, std=1.0: _assign(
+        self, random_ops.gaussian(self.shape, mean, std, self.dtype))
+    T.tile = manipulation.tile
+    T.expand = manipulation.expand
+    T.expand_as = manipulation.expand_as
+    T.gather = manipulation.gather
+    T.gather_nd = manipulation.gather_nd
+    T.scatter = manipulation.scatter
+    T.split = manipulation.split
+    T.chunk = manipulation.chunk
+    T.concat = manipulation.concat
+    T.unbind = manipulation.unbind
+    T.numel = lambda self: manipulation.numel(self)
+    T.norm = linalg.norm
+
+
+def _inplace(t, fn, *args, **kwargs):
+    """Compute fn over a shadow of t (preserving t's pre-mutation autograd
+    identity — see core.dispatch.shadow), then redirect t to the result."""
+    from ..core.dispatch import assign_inplace, shadow
+    new = fn(shadow(t), *args, **kwargs)
+    return assign_inplace(t, new)
+
+
+def _assign(t, new):
+    from ..core.dispatch import assign_inplace
+    return assign_inplace(t, new)

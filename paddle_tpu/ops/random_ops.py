@@ -1,0 +1,128 @@
+"""Random sampling ops (reference surface: python/paddle/tensor/random.py).
+
+Eager calls draw keys from the global generator; inside a compiled step a
+scoped key stream (paddle_tpu.core.random.key_stream) supplies deterministic
+per-site subkeys of the step key.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core import random as _rnd
+from ..core.dispatch import call
+from ..core.tensor import Tensor
+
+
+def _d(dtype):
+    return _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._array) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None):
+    return Tensor(jax.random.uniform(_rnd.next_key(), _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None):
+    return Tensor(jax.random.normal(_rnd.next_key(), _shape(shape), _d(dtype)))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.key(seed) if seed else _rnd.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._array if isinstance(mean, Tensor) else mean
+        s = std._array if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_rnd.next_key(), shp) * s + m)
+    return Tensor(jax.random.normal(_rnd.next_key(), _shape(shape)) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None):
+    return Tensor(jax.random.normal(_rnd.next_key(), _shape(shape), _d(dtype)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_rnd.next_key(), _shape(shape), low, high,
+                                     _d(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, x.shape, dtype)
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(jax.random.permutation(_rnd.next_key(), n).astype(_d(dtype)))
+
+
+def shuffle(x, axis=0):
+    return call(lambda a: jax.random.permutation(_rnd.next_key(), a, axis=axis,
+                                                 independent=False),
+                x, name="shuffle")
+
+
+def bernoulli(x):
+    return call(lambda p: jax.random.bernoulli(_rnd.next_key(), p).astype(p.dtype),
+                x, name="bernoulli")
+
+
+def poisson(x):
+    return call(lambda lam: jax.random.poisson(_rnd.next_key(), lam).astype(lam.dtype),
+                x, name="poisson")
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    arr = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    key = _rnd.next_key()
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(arr.shape[:-1] + (num_samples,))
+                                     if arr.ndim > 1 else (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, arr.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0):
+    arr = jax.random.exponential(_rnd.next_key(), tuple(x.shape), x._array.dtype) / lam
+    x._array = arr
+    return x
+
+
+def binomial(count, prob):
+    c = count._array if isinstance(count, Tensor) else count
+    p = prob._array if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(_rnd.next_key(), c, p).astype(jnp.int64))
+
+
+def rand_like(x, dtype=None):
+    return rand(x.shape, dtype or x.dtype)
+
+
+def randn_like(x, dtype=None):
+    return randn(x.shape, dtype or x.dtype)
+
+
+def normal_like(x, mean=0.0, std=1.0):
+    return gaussian(x.shape, mean, std, x.dtype)

@@ -1,0 +1,114 @@
+"""Reductions (reference surface: python/paddle/tensor/math.py sum/mean/...,
+stat.py std/var/median, logic.py all/any)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import wrap_op
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@wrap_op
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@wrap_op
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@wrap_op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@wrap_op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@wrap_op
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@wrap_op
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim).astype(jnp.int64)
+
+
+@wrap_op
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@wrap_op
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=_norm_axis(axis),
+                        keepdims=keepdim, method=interpolation)
+
+
+@wrap_op
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim)

@@ -1,0 +1,168 @@
+"""Search / sort ops (reference surface: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call, wrap_op
+from ..core.tensor import Tensor
+
+
+@wrap_op
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(str(dtype)) if isinstance(dtype, str) else dtype)
+
+
+@wrap_op
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(str(dtype)) if isinstance(dtype, str) else dtype)
+
+
+@wrap_op
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@wrap_op
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+@wrap_op
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if isinstance(k, jnp.ndarray):
+        k = int(k)
+    axis_ = axis if axis >= 0 else x.ndim + axis
+    moved = jnp.moveaxis(x, axis_, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis_), jnp.moveaxis(idx, -1, axis_).astype(jnp.int64))
+
+
+@wrap_op
+def kthvalue(x, k, axis=-1, keepdim=False):
+    axis_ = axis if axis >= 0 else x.ndim + axis
+    sorted_vals = jnp.sort(x, axis=axis_)
+    sorted_idx = jnp.argsort(x, axis=axis_)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis_)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis_)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis_)
+        idx = jnp.expand_dims(idx, axis_)
+    return vals, idx.astype(jnp.int64)
+
+
+@wrap_op
+def mode(x, axis=-1, keepdim=False):
+    axis_ = axis if axis >= 0 else x.ndim + axis
+    moved = jnp.moveaxis(x, axis_, -1)          # (..., n)
+    n = moved.shape[-1]
+    # O(n^2) pairwise count — fine for the modest n this op sees
+    counts = jnp.sum(moved[..., :, None] == moved[..., None, :], axis=-1)
+    # break count ties toward the larger value (paddle semantics)
+    score = counts.astype(jnp.float32) * (n + 1) + jnp.argsort(jnp.argsort(moved, axis=-1), axis=-1)
+    pos = jnp.argmax(score, axis=-1)
+    vals = jnp.take_along_axis(moved, pos[..., None], axis=-1)[..., 0]
+    # index of the last occurrence of the modal value
+    idx = (n - 1) - jnp.argmax(jnp.flip(moved == vals[..., None], axis=-1), axis=-1)
+    if keepdim:
+        vals = jnp.moveaxis(vals[..., None], -1, axis_)
+        idx = jnp.moveaxis(idx[..., None], -1, axis_)
+    return vals, idx.astype(jnp.int64)
+
+
+@wrap_op
+def where_raw(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return where_raw(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape — eager only
+    import numpy as np
+    arr = np.asarray(x._array if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None], jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), jnp.int64))
+
+
+@wrap_op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@wrap_op
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64"):
+    # dynamic shape — eager only (reference has the same static-graph caveat)
+    import numpy as np
+    arr = np.asarray(x._array if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    arr = np.asarray(x._array if isinstance(x, Tensor) else x)
+    if axis is None:
+        flat = arr.reshape(-1)
+        keep = np.ones(len(flat), bool)
+        keep[1:] = flat[1:] != flat[:-1]
+        out = flat[keep]
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(flat)))
+        outs.append(Tensor(jnp.asarray(counts, np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def _running_argextreme(x, axis, is_max):
+    """Index stream for cummax/cummin."""
+    def raw(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        n = moved.shape[-1]
+        vals = jax.lax.cummax(moved, axis=moved.ndim - 1) if is_max \
+            else jax.lax.cummin(moved, axis=moved.ndim - 1)
+        hits = moved == vals
+        idx = jnp.arange(n)
+        run_idx = jax.lax.cummax(jnp.where(hits, idx, -1), axis=moved.ndim - 1)
+        return jnp.moveaxis(run_idx, -1, axis).astype(jnp.int64)
+    return call(raw, x, name="running_argextreme")
+
+
+@wrap_op
+def masked_scatter(x, mask, value):
+    flat_value = value.reshape(-1)
+    cnt = jnp.cumsum(mask.reshape(-1).astype(jnp.int32)) - 1
+    gathered = flat_value[jnp.clip(cnt, 0, flat_value.shape[0] - 1)].reshape(x.shape)
+    return jnp.where(mask, gathered, x)

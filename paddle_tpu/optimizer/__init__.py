@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (reference surface: python/paddle/optimizer/)."""
+from . import lr
+from .optimizer import Optimizer
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                         Momentum, RMSProp)

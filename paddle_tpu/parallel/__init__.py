@@ -1,0 +1,13 @@
+"""Alias package: the parallelism stack lives in paddle_tpu.distributed
+(mesh, collectives, mp_layers, pipeline, sharding, fleet).  This namespace
+re-exports it under the build plan's `parallel/` name."""
+from ..distributed import *  # noqa: F401,F403
+from ..distributed import collective, fleet, mesh, mp_layers, pipeline, sharding  # noqa: F401
+from ..distributed.mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                                     RowParallelLinear, TensorParallel,
+                                     VocabParallelEmbedding,
+                                     get_rng_state_tracker,
+                                     with_sharding_constraint)
+from ..distributed.pipeline import (LayerDesc, PipelineLayer, PipelineParallel,
+                                    SegmentLayers, SharedLayerDesc,
+                                    spmd_pipeline)

@@ -1,0 +1,248 @@
+"""Profiler (reference surface: python/paddle/profiler/ — Profiler context
+manager with scheduler windows at profiler.py:264, RecordEvent spans, ips
+timer at timer.py).
+
+TPU-native: host spans are recorded by our own lock-free-enough recorder and
+exported as chrome://tracing JSON (the reference's chrometracing_logger.cc),
+while device activity comes from jax.profiler (XPlane -> TensorBoard /
+Perfetto) when a trace dir is given.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """reference parity: profiler.py:67 make_scheduler — step-state machine."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class _HostEventRecorder:
+    """Per-thread span buffers merged at export
+    (reference: host_event_recorder.h)."""
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+
+    def add(self, name, ts, dur, tid):
+        with self._lock:
+            self._events.append((name, ts, dur, tid))
+
+    def drain(self):
+        with self._lock:
+            ev, self._events = self._events, []
+        return ev
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Span instrumentation (reference: platform::RecordEvent; hooks sat in
+    every runtime hot path e.g. interpretercore.cc:581)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin is None:
+            return
+        now = time.perf_counter_ns()
+        _recorder.add(self.name, self._begin, now - self._begin,
+                      threading.get_ident())
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """Returns an on_trace_ready callback writing chrome://tracing JSON
+    (reference: profiler.py:154)."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_{os.getpid()}"
+            f"_{int(time.time() * 1000)}.pt.trace.json")
+        events = [{
+            "name": name, "ph": "X", "ts": ts / 1000.0, "dur": dur / 1000.0,
+            "pid": os.getpid(), "tid": tid, "cat": "host",
+        } for name, ts, dur, tid in prof._drained_events]
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof._last_export = fname
+
+    return handler
+
+
+class Profiler:
+    """reference parity: python/paddle/profiler/profiler.py:264."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 trace_dir=None):
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=end - start, repeat=1)
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._state = ProfilerState.RECORD if scheduler is None else \
+            ProfilerState.CLOSED
+        self._drained_events = []
+        self._last_export = None
+        self._timer_only = timer_only
+        self._trace_dir = trace_dir
+        self._jax_tracing = False
+        self.benchmark = TimerHub()
+
+    def start(self):
+        self.benchmark.begin()
+        if self._trace_dir and not self._timer_only:
+            jax.profiler.start_trace(self._trace_dir)
+            self._jax_tracing = True
+        return self
+
+    def stop(self):
+        if self._jax_tracing:
+            jax.profiler.stop_trace()
+            self._jax_tracing = False
+        self._drained_events.extend(_recorder.drain())
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.benchmark.step(num_samples)
+        self._step += 1
+        if self._scheduler:
+            self._state = self._scheduler(self._step)
+            if self._state == ProfilerState.RECORD_AND_RETURN:
+                self._drained_events.extend(_recorder.drain())
+                if self._on_trace_ready:
+                    self._on_trace_ready(self)
+
+    def step_info(self, unit="samples"):
+        return self.benchmark.step_info(unit)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        by_name = {}
+        for name, ts, dur, tid in self._drained_events:
+            agg = by_name.setdefault(name, [0, 0.0])
+            agg[0] += 1
+            agg[1] += dur / 1e6
+        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
+        for name, (calls, total) in sorted(by_name.items(),
+                                           key=lambda kv: -kv[1][1]):
+            lines.append(f"{name[:40]:40s} {calls:8d} {total:12.3f}")
+        return "\n".join(lines)
+
+
+class TimerHub:
+    """Throughput (ips) timer — reference: python/paddle/profiler/timer.py."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self._last = None
+        self._steps = 0
+        self._samples = 0
+        self._window = []
+
+    def begin(self):
+        self._start = self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._window.append(now - self._last)
+            if len(self._window) > 100:
+                self._window.pop(0)
+        self._last = now
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+
+    def step_info(self, unit="samples"):
+        if not self._window:
+            return "no steps recorded"
+        avg = sum(self._window) / len(self._window)
+        ips = (self._samples / max(self._steps, 1)) / avg if self._samples else 1.0 / avg
+        return (f"avg_step_time: {avg * 1000:.3f} ms, "
+                f"ips: {ips:.2f} {unit}/s")
+
+
+@contextlib.contextmanager
+def profiler_guard(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

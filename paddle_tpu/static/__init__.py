@@ -1,0 +1,175 @@
+"""Static-graph facade (reference surface: python/paddle/static/).
+
+TPU-native meaning of "static graph": a jitted + lowered XLA/StableHLO
+program.  ``save_inference_model`` exports StableHLO text + weights (the
+analogue of the reference's __model__ ProgramDesc + params,
+static/io.py:433); ``load_inference_model`` returns an executable predictor.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import StaticFunction, to_static
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def _to_shape_dtype(self):
+        shape = tuple(1 if (s is None or s == -1) else int(s)
+                      for s in (self.shape or []))
+        from ..core.dtype import convert_dtype
+        return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, model=None, input_spec=None, **kwargs):
+    """Export a compiled inference artifact.
+
+    TPU-native form: StableHLO text of the jitted forward + a weights pickle.
+    ``model`` (a Layer) + ``input_spec`` is the primary TPU path; the
+    feed/fetch-vars signature is accepted for API parity.
+    """
+    if model is None:
+        raise ValueError("TPU build: pass model=<Layer> and input_spec=[...]")
+    from ..jit import functional_call
+
+    state = model.functional_state()
+    specs = [s._to_shape_dtype() if isinstance(s, InputSpec) else s
+             for s in (input_spec or [])]
+    model.eval()
+
+    def fwd(state, *args):
+        out, _ = functional_call(model, state, *args)
+        return out
+
+    lowered = jax.jit(fwd).lower(state, *specs)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".stablehlo.mlir", "w") as f:
+        f.write(lowered.as_text(dialect="stablehlo"))
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in state.items()}, f)
+    meta = {"input_specs": [(list(s.shape), str(s.dtype)) for s in specs]}
+    with open(path_prefix + ".pdmodel.meta", "wb") as f:
+        pickle.dump(meta, f)
+    return path_prefix
+
+
+class _Predictor:
+    def __init__(self, fn, state):
+        self._fn = fn
+        self._state = state
+
+    def run(self, feeds):
+        arrs = [f._array if isinstance(f, Tensor) else jnp.asarray(f)
+                for f in feeds]
+        out = self._fn(self._state, *arrs)
+        return [Tensor(o) for o in jax.tree_util.tree_leaves(out)]
+
+    def __call__(self, *feeds):
+        return self.run(list(feeds))
+
+
+def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
+    """Load the exported artifact. If the original Layer class is supplied via
+    ``model``, rebuilds an executable predictor (weights + jitted forward)."""
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    state = {k: jnp.asarray(v) for k, v in state.items()}
+    if model is not None:
+        from ..jit import functional_call
+        model.eval()
+
+        @jax.jit
+        def fwd(state, *args):
+            out, _ = functional_call(model, state, *args)
+            return out
+
+        return _Predictor(fwd, state)
+    # without the Layer, return raw artifacts (StableHLO text + weights)
+    with open(path_prefix + ".stablehlo.mlir") as f:
+        hlo_text = f.read()
+    return hlo_text, state
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """API-compat shim: tracing replaces program construction."""
+    yield
+
+
+class Program:
+    """API-compat shim for code that passes Program objects around."""
+
+    def __init__(self):
+        pass
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class ExecutionStrategy:
+    pass
+
+
+class BuildStrategy:
+    pass
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """API-compat minimal executor: run(fn, feed, fetch) over jitted fns."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "The TPU build has no ProgramDesc interpreter; use "
+            "paddle_tpu.jit.to_static / TrainStep (SURVEY.md §7 table).")
+
+
+# namespace parity: paddle.static.nn
+class nn:
+    @staticmethod
+    def fc(x, size, **kw):
+        raise NotImplementedError("use paddle_tpu.nn.Linear")

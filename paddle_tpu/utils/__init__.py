@@ -1,0 +1,30 @@
+"""Utilities (reference surface: python/paddle/utils/)."""
+from __future__ import annotations
+
+from . import flags  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def run_check():
+    """paddle.utils.run_check equivalent: verify the accelerator works."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    n = jax.device_count()
+    print(f"paddle_tpu works! backend={jax.default_backend()}, devices={n}")
+    return True
+
+
+def deprecated(update_to="", since="", reason=""):
+    def deco(fn):
+        return fn
+    return deco

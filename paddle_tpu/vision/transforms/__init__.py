@@ -1,0 +1,241 @@
+"""Vision transforms (reference surface: python/paddle/vision/transforms/) —
+numpy/CHW-based functional + composable class transforms."""
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _to_numpy(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._array)
+    return np.asarray(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if self.data_format == "CHW":
+            n = arr.shape[0]
+            mean = self.mean[:n].reshape(-1, 1, 1)
+            std = self.std[:n].reshape(-1, 1, 1)
+        else:
+            n = arr.shape[-1]
+            mean = self.mean[:n]
+            std = self.std[:n]
+        out = (arr - mean) / std
+        if isinstance(img, Tensor):
+            return Tensor(out)
+        return out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        import jax
+        import jax.numpy as jnp
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            shape = (arr.shape[0],) + tuple(self.size)
+        elif arr.ndim == 3:
+            shape = tuple(self.size) + (arr.shape[-1],)
+        else:
+            shape = tuple(self.size)
+        out = np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), shape,
+                                          method="bilinear"))
+        return out.astype(arr.dtype) if arr.dtype != np.uint8 else \
+            np.clip(out, 0, 255).astype(np.uint8)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            pads = ((0, 0), (p, p), (p, p)) if chw else \
+                ((p, p), (p, p)) + ((0, 0),) * (arr.ndim - 2)
+            arr = np.pad(arr, pads)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i = _pyrandom.randint(0, max(h - th, 0))
+        j = _pyrandom.randint(0, max(w - tw, 0))
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if _pyrandom.random() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
+        return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if _pyrandom.random() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            return arr[:, ::-1, :].copy() if chw else arr[::-1].copy()
+        return arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = h * w
+        for _ in range(10):
+            target_area = area * _pyrandom.uniform(*self.scale)
+            ar = _pyrandom.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if tw <= w and th <= h:
+                i = _pyrandom.randint(0, h - th)
+                j = _pyrandom.randint(0, w - tw)
+                crop = arr[:, i:i + th, j:j + tw] if chw else arr[i:i + th, j:j + tw]
+                return self._resize._apply_image(crop)
+        return self._resize._apply_image(arr)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+# functional aliases
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = _to_numpy(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
+
+
+def vflip(img):
+    arr = _to_numpy(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[:, ::-1, :].copy() if chw else arr[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)

@@ -1,0 +1,27 @@
+"""Test configuration: run on a virtual 8-device CPU mesh so multi-chip
+sharding paths execute without TPU hardware (SURVEY.md §4 — the analogue of
+the reference's multi-process-on-one-host distributed test pattern)."""
+import os
+
+# Force an 8-virtual-device CPU backend for tests.  jax may already be
+# imported (a sitecustomize TPU-tunnel plugin imports it at interpreter
+# start), but the backend itself initializes lazily — os.environ XLA_FLAGS +
+# jax.config still apply as long as no computation ran yet.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# full-f32 accumulations so numpy/torch parity checks are meaningful
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    yield

@@ -1,0 +1,200 @@
+"""Op parity vs numpy (the OpTest pattern — reference:
+python/paddle/fluid/tests/unittests/op_test.py:289: outputs vs numpy
+reference + numeric-vs-analytic gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RTOL = 1e-5
+
+
+def check_grad(op, *np_inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Central-difference vs analytic — mirrors OpTest.check_grad."""
+    tensors = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+               for a in np_inputs]
+    out = op(*tensors)
+    out.sum().backward()
+    for t, a in zip(tensors, np_inputs):
+        analytic = t.grad.numpy()
+        numeric = np.zeros_like(a, dtype=np.float64)
+        flat = a.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            plus = float(op(*[paddle.to_tensor(x.astype(np.float32))
+                              for x in np_inputs]).sum().numpy())
+            flat[i] = orig - eps
+            minus = float(op(*[paddle.to_tensor(x.astype(np.float32))
+                               for x in np_inputs]).sum().numpy())
+            flat[i] = orig
+            numeric.reshape(-1)[i] = (plus - minus) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("abs", np.abs),
+    ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+    ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+])
+def test_unary_parity(name, np_fn):
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    out = getattr(paddle, name)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np_fn(x), rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power),
+])
+def test_binary_parity(name, np_fn):
+    a = np.random.rand(3, 4).astype(np.float32) + 1.0
+    b = np.random.rand(3, 4).astype(np.float32) + 1.0
+    out = getattr(paddle, name)(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), np_fn(a, b), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ([0, 1], False)])
+def test_reductions(axis, keepdim):
+    x = np.random.rand(4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(
+        paddle.sum(t, axis=axis, keepdim=keepdim).numpy(),
+        np.sum(x, axis=tuple(axis) if isinstance(axis, list) else axis,
+               keepdims=keepdim), rtol=RTOL)
+    np.testing.assert_allclose(
+        paddle.mean(t, axis=axis, keepdim=keepdim).numpy(),
+        np.mean(x, axis=tuple(axis) if isinstance(axis, list) else axis,
+                keepdims=keepdim), rtol=RTOL)
+
+
+def test_matmul_variants():
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    b = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        a @ b, rtol=RTOL)
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.swapaxes(1, 2)),
+                      transpose_y=True).numpy(),
+        a @ b, rtol=1e-4)
+
+
+def test_manipulation_suite():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    assert paddle.unsqueeze(t, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    c = paddle.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.split(t, 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1])
+    np.testing.assert_allclose(paddle.tile(t, [1, 2, 1]).numpy(),
+                               np.tile(x, (1, 2, 1)))
+
+
+def test_gather_scatter():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2])
+    out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx])
+    upd = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(np.ones((2, 3), np.float32)))
+    expect = x.copy()
+    expect[idx] = 1
+    np.testing.assert_allclose(upd.numpy(), expect)
+
+
+def test_where_sort_topk():
+    x = np.random.rand(3, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(),
+                               np.sort(x, axis=1))
+    np.testing.assert_array_equal(paddle.argsort(t, axis=1).numpy(),
+                                  np.argsort(x, axis=1))
+    vals, idx = paddle.topk(t, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), np.sort(x, axis=1)[:, -1:-3:-1])
+    cond = paddle.to_tensor(x > 0.5)
+    out = paddle.where(cond, t, paddle.zeros_like(t))
+    np.testing.assert_allclose(out.numpy(), np.where(x > 0.5, x, 0))
+
+
+def test_cumsum_logsumexp():
+    x = np.random.rand(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                               np.cumsum(x, axis=1), rtol=RTOL)
+    from scipy.special import logsumexp as sp_lse
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                               sp_lse(x, axis=1), rtol=1e-4)
+
+
+def test_linalg_suite():
+    a = np.random.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    np.testing.assert_allclose(paddle.inverse(t).numpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg_cholesky(t).numpy()
+                               if hasattr(paddle, 'linalg_cholesky')
+                               else paddle.cholesky(t).numpy(),
+                               np.linalg.cholesky(spd), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.norm(paddle.to_tensor(a)).numpy(),
+                               np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                      paddle.to_tensor(a)).numpy(), a @ a, rtol=1e-4)
+
+
+def test_grad_unary_ops():
+    x = np.random.rand(2, 3) + 0.5
+    check_grad(paddle.exp, x.copy())
+    check_grad(paddle.log, x.copy())
+    check_grad(paddle.sqrt, x.copy())
+    check_grad(paddle.tanh, x.copy())
+
+
+def test_grad_binary_ops():
+    a = np.random.rand(2, 2) + 0.5
+    b = np.random.rand(2, 2) + 0.5
+    check_grad(paddle.multiply, a.copy(), b.copy())
+    check_grad(paddle.divide, a.copy(), b.copy())
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == np.int32
+    np.testing.assert_allclose(paddle.full([2, 2], 7.0).numpy(),
+                               np.full((2, 2), 7.0))
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=RTOL)
+    assert paddle.eye(3).shape == [3, 3]
+    x = paddle.to_tensor([[1.0, 2], [3, 4]])
+    np.testing.assert_allclose(paddle.tril(x).numpy(),
+                               np.tril(x.numpy()))
+    np.testing.assert_allclose(paddle.diag(paddle.to_tensor([1.0, 2])).numpy(),
+                               np.diag([1.0, 2]))
+
+
+def test_random_ops_shapes():
+    paddle.seed(7)
+    a = paddle.rand([3, 4])
+    b = paddle.randn([3, 4])
+    c = paddle.randint(0, 10, [5])
+    d = paddle.randperm(8)
+    assert a.shape == [3, 4] and b.shape == [3, 4]
+    assert c.dtype == np.int64
+    assert sorted(d.tolist()) == list(range(8))
+    paddle.seed(7)
+    a2 = paddle.rand([3, 4])
+    np.testing.assert_allclose(a.numpy(), a2.numpy())  # determinism

@@ -1,0 +1,170 @@
+"""Eager Tensor + tape autograd tests (reference model:
+python/paddle/fluid/tests/unittests/test_imperative_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    assert t.stop_gradient
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert paddle.to_tensor(1).dtype == np.int64
+    assert paddle.to_tensor(1.0).dtype == np.float32
+    assert paddle.to_tensor(True).dtype == np.bool_
+
+
+def test_arith_and_broadcast():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([[1.0], [2.0]])
+    c = a + b
+    assert c.shape == [2, 2]
+    np.testing.assert_allclose((a * 3).numpy(), [3, 6])
+    np.testing.assert_allclose((a - 1).numpy(), [0, 1])
+    np.testing.assert_allclose((2 / a).numpy(), [2, 1])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_backward_chain_and_accumulate():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x  # dy/dx = 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+    # second backward accumulates
+    z = x * 5.0
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 17.0)
+
+
+def test_backward_fanout():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = a + 1
+    c = a * 3
+    loss = (b + c).sum()   # d/dx = 2*(1) + 2*3 = 8
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8, 8])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+    with pytest.raises(RuntimeError):
+        y.backward()  # graph freed
+
+
+def test_no_grad():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx, = paddle.grad(z, [x])
+    np.testing.assert_allclose(gx.numpy(), [3, 4])
+    assert x.grad is None  # grad() must not write .grad
+
+
+def test_grad_hook():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6, 6])
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (y * d).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_backward_through_indexing():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x[0] * 2
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2], [0, 0]])
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 1
+    y[1] = paddle.to_tensor(10.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    (a @ b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_int_outputs_no_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    idx = paddle.argsort(x)
+    assert idx.stop_gradient
+    vals, topi = paddle.topk(x, 2)
+    assert not vals.stop_gradient
+    assert topi.stop_gradient
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_inplace_methods():
+    x = paddle.to_tensor([1.0, -2.0])
+    x.clip_(min=0)
+    np.testing.assert_allclose(x.numpy(), [1, 0])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0, 0])
+
+
+def test_cast_and_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.astype(paddle.bfloat16)
+    assert str(z.dtype) == "bfloat16"
+
+
+def test_comparison_returns_bool():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([2.0, 2.0])
+    assert (a == b).dtype == np.bool_
+    np.testing.assert_array_equal((a < b).numpy(), [True, False])
