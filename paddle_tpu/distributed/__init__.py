@@ -22,6 +22,8 @@ from .parallel_base import (DataParallel, ParallelEnv, get_rank,
                             shard_tensor, shard_dataloader)
 from . import fleet
 from .sharding import group_sharded_parallel, save_group_sharded_model
+from . import moe, mp_layers, pipeline, ring_attention
+from .recompute import recompute, recompute_sequential
 
 __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",

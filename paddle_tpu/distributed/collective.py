@@ -161,16 +161,18 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
         tensor = tensor_list
         tensor_list = None
 
+    as_list = tensor_list is not None
+
     def raw(x):
         if not _in_trace(grp_axis):
-            return x[None] if tensor_list is not None else x
-        return jax.lax.all_gather(x, grp_axis, axis=0)
+            return x[None] if as_list else x
+        # list form stacks per-rank shards; tensor form concatenates on dim 0
+        return jax.lax.all_gather(x, grp_axis, axis=0, tiled=not as_list)
 
     out = call(raw, tensor, name="all_gather")
-    if tensor_list is not None:
-        n = max(_mesh.axis_size(grp_axis), 1)
+    if as_list:
         from .. import ops
-        parts = ops.unbind(out, 0) if _in_trace(grp_axis) or True else [out]
+        parts = ops.unbind(out, 0)
         tensor_list.clear()
         tensor_list.extend(parts)
         return tensor_list

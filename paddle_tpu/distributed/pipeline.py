@@ -106,6 +106,13 @@ class PipelineLayer(Layer):
         return x
 
 
+def _ensure_varying(arr, axis):
+    try:
+        return jax.lax.pvary(arr, axis)
+    except (AttributeError, ValueError):
+        return arr
+
+
 def spmd_pipeline(stage_fn: Callable, stacked_params, x, num_stages: int,
                   num_micro: int, axis: str = "pp"):
     """Run a pipeline INSIDE a shard_map over `axis`.
@@ -147,6 +154,10 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, num_stages: int,
                                    jax.lax.dynamic_index_in_dim(
                                        x, 0, axis=0, keepdims=False)))
     outputs0 = jnp.zeros((num_micro,) + buf0.shape, buf0.dtype)
+    # newer jax: constants entering the loop must be device-varying; no-op
+    # when the value is already varying or pvary doesn't exist
+    buf0 = _ensure_varying(buf0, axis)
+    outputs0 = _ensure_varying(outputs0, axis)
     _, outputs = jax.lax.fori_loop(0, num_micro + num_stages - 1, tick,
                                    (buf0, outputs0))
     # outputs live on the last stage; broadcast them to all stages so the

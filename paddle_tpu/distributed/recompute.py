@@ -1,0 +1,86 @@
+"""Activation recompute (reference: python/paddle/distributed/fleet/utils/
+recompute.py:199,:331 — a PyLayer that re-runs the block in backward under
+RNGStatesTracker).
+
+TPU-native: ``jax.checkpoint`` (remat) IS recompute, applied at a functional
+boundary.  ``recompute(fn, *args)`` works on both paths:
+
+* compiled path (inside jit/grad trace): wraps the block in jax.checkpoint
+  so XLA rematerialises its activations in backward — identical memory/
+  compute trade as the reference, chosen by the same call-site annotation.
+* eager tape path: records ONE GradNode for the whole block whose vjp
+  re-runs the block under jax.vjp at backward time — activations inside the
+  block are not held by the tape (the PyLayer behavior).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import random as _rnd
+from ..core.dispatch import call, unwrap
+from ..core.grad_mode import no_grad
+from ..core.tensor import Tensor
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Run ``function(*args)`` with activation rematerialisation.
+
+    ``function`` may be a Layer or any callable over Tensors.
+    """
+    key = _rnd.next_key() if preserve_rng_state else None
+    tensor_args = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+    # the block's parameters must be explicit vjp inputs, or the eager tape
+    # would treat them as constants and drop their gradients
+    params = (list(function.parameters())
+              if hasattr(function, "parameters") else [])
+    n_in = len(tensor_args)
+
+    def raw(*arrays):
+        def inner(*arrs):
+            ins, p_arrs = arrs[:n_in], arrs[n_in:]
+            old = [p._array for p in params]
+            for p, a in zip(params, p_arrs):
+                p._array = a
+            try:
+                ctx = _rnd.key_stream(key) if key is not None else _nullctx()
+                with no_grad(), ctx:
+                    out = function(*[Tensor(a) for a in ins], **kwargs)
+                return unwrap(out)
+            finally:
+                for p, a in zip(params, old):
+                    p._array = a
+        return jax.checkpoint(inner)(*arrays)
+
+    return call(raw, *tensor_args, *params, name="recompute")
+
+
+class _nullctx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+def recompute_sequential(functions, x, segments=1):
+    """Checkpoint a Sequential in ``segments`` chunks
+    (reference: recompute_sequential in later paddle; here for parity)."""
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+
+        def run_chunk(inp, _chunk=chunk):
+            for l in _chunk:
+                inp = l(inp)
+            return inp
+
+        run_chunk.parameters = lambda _chunk=chunk: [
+            p for l in _chunk if hasattr(l, "parameters")
+            for p in l.parameters()]
+        x = recompute(run_chunk, x)
+        i += per
+    return x

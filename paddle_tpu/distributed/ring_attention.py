@@ -1,0 +1,149 @@
+"""Ring attention — sequence/context parallelism over the 'sep' mesh axis.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7: no ring
+attention/Ulysses/blockwise anywhere in the snapshot); this is a required
+capability of the TPU build.  Design:
+
+* ring_attention: each device holds a sequence shard of Q and of K/V.
+  K/V shards rotate around the ring via lax.ppermute (ICI neighbor
+  exchange) while each device accumulates blockwise-softmax statistics for
+  its Q shard — O(S_local) memory, compute overlapped with the rotation by
+  XLA's async collectives.  Causality is enforced from global block
+  positions (axis_index).
+* ulysses_attention: the all-to-all variant — resharding (seq-sharded ->
+  head-sharded) with two lax.all_to_all calls around ordinary local
+  attention; composes with TP by splitting the head dim.
+
+Both are plain jax functions intended for use inside shard_map (see
+tests/test_distributed.py for the driving pattern); grads flow through
+scan+ppermute natively.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _local_attn_block(q, k, v, scale, mask):
+    """One (Sq_local x Sk_block) attention block in f32 stats.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); mask: (Sq, Sk) bool or None.
+    Returns (m, l, acc): running max (B,H,Sq), denom (B,H,Sq),
+    weighted values (B,H,Sq,D).
+    """
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale=None):
+    """Blockwise ring attention under shard_map.
+
+    q, k, v: (B, H, S_local, D) — the local sequence shard.
+    Returns (B, H, S_local, D).
+    """
+    n = jax.lax.axis_size(axis_name) if hasattr(jax.lax, "axis_size") else \
+        jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    scale = jnp.float32(scale)
+
+    q32 = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        m, l, acc, kv = carry
+        k_blk, v_blk = kv
+        src = (my - i) % n   # which shard's K/V we currently hold
+        if causal:
+            # block-level causality on global positions
+            q_pos = my * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0)
+            k_pos = src * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            mask = k_pos <= q_pos
+        else:
+            mask = None
+        bm, bl, bacc = _local_attn_block(q32, k_blk, v_blk, scale, mask)
+        new_m = jnp.maximum(m, bm)
+        corr = jnp.exp(m - new_m)
+        bcorr = jnp.exp(bm - new_m)
+        new_l = l * corr + bl * bcorr
+        new_acc = acc * corr[..., None] + bacc * bcorr[..., None]
+        # rotate K/V to the next device (skipped result unused on last step)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (new_m, new_l, new_acc, (k_next, v_next)), None
+
+    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    def _vary(a):  # newer jax: carry constants must be device-varying
+        try:
+            return jax.lax.pvary(a, axis_name)
+        except (AttributeError, ValueError):
+            return a
+
+    m0, l0, acc0 = _vary(m0), _vary(l0), _vary(acc0)
+    (m, l, acc, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, (k.astype(jnp.float32), v.astype(jnp.float32))),
+        jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale=None, attn_fn=None):
+    """DeepSpeed-Ulysses style: all_to_all heads<->sequence, local attention,
+    all_to_all back.  q/k/v: (B, H, S_local, D) with H divisible by the axis
+    size; inside, each device sees (B, H/n, S_full, D)."""
+    n = jax.lax.psum(1, axis_name)
+
+    def seq_to_head(x):
+        b, h, s_loc, d = x.shape
+        x = x.reshape(b, n, h // n, s_loc, d)
+        x = jnp.moveaxis(x, 1, 0)                      # (n, b, h/n, s_loc, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                               tiled=False)            # n dim now = seq chunks
+        x = jnp.moveaxis(x, 0, 3)                      # (b, h/n, s_loc, n, d)
+        b2, hn, s_loc2, n2, d2 = x.shape
+        # (b, h/n, n, s_loc, d) -> concat seq chunks in ring order
+        return jnp.reshape(jnp.swapaxes(x, 2, 3), (b2, hn, n2 * s_loc2, d2))
+
+    def head_to_seq(x):
+        b, hn, s_full, d = x.shape
+        s_loc = s_full // n
+        x = x.reshape(b, hn, n, s_loc, d)
+        x = jnp.moveaxis(x, 2, 0)                      # (n, b, h/n, s_loc, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                               tiled=False)
+        x = jnp.moveaxis(x, 0, 1)                      # (b, n, h/n, s_loc, d)
+        return x.reshape(b, x.shape[1] * x.shape[2], s_loc, d)
+
+    q2, k2, v2 = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    if attn_fn is None:
+        def attn_fn(q_, k_, v_):
+            d = q_.shape[-1]
+            s = scale if scale is not None else 1.0 / (d ** 0.5)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_).astype(jnp.float32) * s
+            if causal:
+                sq = logits.shape[-2]
+                mask = jnp.tril(jnp.ones((sq, sq), bool))
+                logits = jnp.where(mask[None, None], logits, _NEG_INF)
+            p = jax.nn.softmax(logits, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p,
+                              v_.astype(jnp.float32)).astype(q_.dtype)
+    out = attn_fn(q2, k2, v2)
+    return head_to_seq(out)
