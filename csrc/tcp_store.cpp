@@ -1,0 +1,335 @@
+// TCPStore — key/value rendezvous server + client.
+//
+// Native C++ re-design of the reference's rendezvous store
+// (paddle/fluid/distributed/store/tcp_store.{h,cc}:91 TCPStore/MasterDaemon):
+// the master rank listens, peers connect over TCP and issue SET/GET/ADD/WAIT.
+// Used by paddle_tpu.distributed bootstrap when jax.distributed's built-in
+// coordination is unavailable (and by tests as the multi-process sync
+// primitive).  Exposed to Python via a plain C ABI + ctypes (no pybind11 in
+// this image).
+//
+// Wire format (little-endian):
+//   u8 op  | u32 keylen | key bytes | (SET/ADD: u32 vallen | val bytes)
+// ops: 1=SET 2=GET 3=ADD 4=WAIT 5=DELETE 6=NUMKEYS
+// replies: GET/WAIT -> u32 len | bytes (len==0xFFFFFFFF => missing)
+//          ADD -> i64 new value; SET/DELETE -> u8 ack; NUMKEYS -> u32
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, DEL = 5, NUM = 6 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, 0);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port), stop_(false) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    if (port == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stop_.compare_exchange_strong(expected, true)) return;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR), ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, key.data(), klen)) break;
+      if (op == SET) {
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !read_full(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          data_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        uint8_t ack = 1;
+        if (!write_full(fd, &ack, 1)) break;
+      } else if (op == GET) {
+        std::string val;
+        bool found;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = data_.find(key);
+          found = it != data_.end();
+          if (found) val = it->second;
+        }
+        uint32_t len = found ? static_cast<uint32_t>(val.size()) : 0xFFFFFFFFu;
+        if (!write_full(fd, &len, 4)) break;
+        if (found && !val.empty() && !write_full(fd, val.data(), val.size()))
+          break;
+      } else if (op == ADD) {
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !read_full(fd, val.data(), vlen)) break;
+        int64_t inc = 0;
+        std::memcpy(&inc, val.data(), std::min<size_t>(8, val.size()));
+        int64_t out;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = data_.find(key);
+          if (it != data_.end())
+            std::memcpy(&cur, it->second.data(),
+                        std::min<size_t>(8, it->second.size()));
+          out = cur + inc;
+          std::string nv(8, '\0');
+          std::memcpy(nv.data(), &out, 8);
+          data_[key] = nv;
+        }
+        cv_.notify_all();
+        if (!write_full(fd, &out, 8)) break;
+      } else if (op == WAIT) {
+        std::string val;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          cv_.wait(lk, [&] {
+            return stop_.load() || data_.count(key) > 0;
+          });
+          if (stop_) break;
+          val = data_[key];
+        }
+        uint32_t len = static_cast<uint32_t>(val.size());
+        if (!write_full(fd, &len, 4)) break;
+        if (!val.empty() && !write_full(fd, val.data(), val.size())) break;
+      } else if (op == DEL) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          data_.erase(key);
+        }
+        uint8_t ack = 1;
+        if (!write_full(fd, &ack, 1)) break;
+      } else if (op == NUM) {
+        uint32_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          n = static_cast<uint32_t>(data_.size());
+        }
+        if (!write_full(fd, &n, 4)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int listen_fd_ = -1;
+  int port_;
+  std::atomic<bool> stop_;
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const char* host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host, &addr.sin_addr);
+    // retry connect for up to ~30s (server may start later)
+    for (int i = 0; i < 300; i++) {
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        ok_ = true;
+        return;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    ::close(fd_);
+    fd_ = -1;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return ok_; }
+
+  bool Set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(SET, key)) return false;
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    if (!write_full(fd_, &vlen, 4)) return false;
+    if (!val.empty() && !write_full(fd_, val.data(), val.size())) return false;
+    uint8_t ack;
+    return read_full(fd_, &ack, 1);
+  }
+
+  // returns -1 missing, else value length written into out (truncated to cap)
+  int64_t Get(const std::string& key, char* out, int64_t cap, bool wait) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(wait ? WAIT : GET, key)) return -2;
+    uint32_t len;
+    if (!read_full(fd_, &len, 4)) return -2;
+    if (len == 0xFFFFFFFFu) return -1;
+    std::string val(len, '\0');
+    if (len && !read_full(fd_, val.data(), len)) return -2;
+    int64_t n = std::min<int64_t>(len, cap);
+    std::memcpy(out, val.data(), static_cast<size_t>(n));
+    return static_cast<int64_t>(len);
+  }
+
+  int64_t Add(const std::string& key, int64_t inc) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(ADD, key)) return INT64_MIN;
+    uint32_t vlen = 8;
+    if (!write_full(fd_, &vlen, 4)) return INT64_MIN;
+    if (!write_full(fd_, &inc, 8)) return INT64_MIN;
+    int64_t out;
+    if (!read_full(fd_, &out, 8)) return INT64_MIN;
+    return out;
+  }
+
+ private:
+  bool SendHeader(Op op, const std::string& key) {
+    uint8_t o = op;
+    if (!write_full(fd_, &o, 1)) return false;
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    if (!write_full(fd_, &klen, 4)) return false;
+    return key.empty() || write_full(fd_, key.data(), key.size());
+  }
+
+  int fd_ = -1;
+  bool ok_ = false;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_create(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tcp_store_server_port(void* server) {
+  return static_cast<StoreServer*>(server)->port();
+}
+
+void tcp_store_server_destroy(void* server) {
+  delete static_cast<StoreServer*>(server);
+}
+
+void* tcp_store_client_create(const char* host, int port) {
+  auto* c = new StoreClient(host, port);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcp_store_client_destroy(void* client) {
+  delete static_cast<StoreClient*>(client);
+}
+
+int tcp_store_set(void* client, const char* key, const char* val, int len) {
+  return static_cast<StoreClient*>(client)->Set(key, std::string(val, len)) ? 0
+                                                                            : -1;
+}
+
+long long tcp_store_get(void* client, const char* key, char* out,
+                        long long cap, int wait) {
+  return static_cast<StoreClient*>(client)->Get(key, out, cap, wait != 0);
+}
+
+long long tcp_store_add(void* client, const char* key, long long inc) {
+  return static_cast<StoreClient*>(client)->Add(key, inc);
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+"""ctypes loader for the native C++ runtime (csrc/).
+
+Builds csrc/libpaddle_tpu_native.so on first use (g++ is in the image; no
+pybind11 — plain C ABI).  Every consumer has a pure-Python fallback, so a
+missing toolchain degrades gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lib = None
+_lock = threading.Lock()
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libpaddle_tpu_native.so")
+
+
+def load():
+    """Return the loaded library or None when unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    with _lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < max(
+                        os.path.getmtime(os.path.join(_CSRC, f))
+                        for f in ("tcp_store.cpp", "shm_queue.cpp"))):
+                subprocess.run(["make", "-s", "-C", _CSRC],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            _lib = False
+            return None
+        # signatures
+        lib.tcp_store_server_create.restype = ctypes.c_void_p
+        lib.tcp_store_server_create.argtypes = [ctypes.c_int]
+        lib.tcp_store_server_port.restype = ctypes.c_int
+        lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_client_create.restype = ctypes.c_void_p
+        lib.tcp_store_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tcp_store_client_destroy.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_set.restype = ctypes.c_int
+        lib.tcp_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_int]
+        lib.tcp_store_get.restype = ctypes.c_longlong
+        lib.tcp_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_longlong,
+                                      ctypes.c_int]
+        lib.tcp_store_add.restype = ctypes.c_longlong
+        lib.tcp_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_longlong]
+        lib.shm_queue_create.restype = ctypes.c_void_p
+        lib.shm_queue_create.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+        lib.shm_queue_open.restype = ctypes.c_void_p
+        lib.shm_queue_open.argtypes = [ctypes.c_char_p]
+        lib.shm_queue_push.restype = ctypes.c_int
+        lib.shm_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_longlong]
+        lib.shm_queue_pop.restype = ctypes.c_longlong
+        lib.shm_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_longlong]
+        lib.shm_queue_size.restype = ctypes.c_longlong
+        lib.shm_queue_size.argtypes = [ctypes.c_void_p]
+        lib.shm_queue_close.argtypes = [ctypes.c_void_p]
+        lib.shm_queue_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return load() is not None

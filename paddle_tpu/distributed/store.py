@@ -1,0 +1,226 @@
+"""TCPStore — Python interface over the native C++ store (csrc/tcp_store.cpp).
+
+reference parity: paddle/fluid/distributed/store/tcp_store.h:91 (TCPStore,
+MasterDaemon) and python `core.TCPStore(master_addr, port, is_master,
+world_size)` used by init_parallel_env (parallel.py:235).  Pure-Python
+fallback server keeps everything working without the native build.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core import native as _native
+
+
+class TCPStore:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self._server = None
+        self._py_server = None
+        lib = _native.load()
+        self._lib = lib
+        if is_master:
+            if lib is not None:
+                self._server = lib.tcp_store_server_create(port)
+                if not self._server:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                port = lib.tcp_store_server_port(self._server)
+            else:
+                self._py_server = _PyStoreServer(port)
+                port = self._py_server.port
+        self.port = port
+        if lib is not None:
+            self._client = lib.tcp_store_client_create(host.encode(), port)
+            if not self._client:
+                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        else:
+            self._client = _PyStoreClient(host, port, timeout)
+
+    def set(self, key: str, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib is not None:
+            rc = self._lib.tcp_store_set(self._client, key.encode(), data,
+                                         len(data))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set failed")
+        else:
+            self._client.set(key, data)
+
+    def get(self, key: str, wait: bool = True) -> bytes:
+        if self._lib is not None:
+            import ctypes
+            cap = 1 << 20
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tcp_store_get(self._client, key.encode(), buf, cap,
+                                        1 if wait else 0)
+            if n == -1:
+                raise KeyError(key)
+            if n < 0:
+                raise RuntimeError("TCPStore.get failed")
+            return buf.raw[:n]
+        return self._client.get(key, wait)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._lib is not None:
+            out = self._lib.tcp_store_add(self._client, key.encode(), amount)
+            if out == -(1 << 63):
+                raise RuntimeError("TCPStore.add failed")
+            return int(out)
+        return self._client.add(key, amount)
+
+    def wait(self, keys, timeout: Optional[float] = None):
+        keys = keys if isinstance(keys, (list, tuple)) else [keys]
+        for k in keys:
+            self.get(k, wait=True)
+
+    def barrier(self, key: str = "_barrier", timeout: float = 60.0):
+        """All world_size participants block until everyone arrived."""
+        n = self.add(key + ":cnt", 1)
+        target = self.world_size
+        if n % target == 0:
+            self.set(key + f":gen{n // target}", b"1")
+        gen = (n + target - 1) // target
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                self.get(key + f":gen{gen}", wait=False)
+                return
+            except KeyError:
+                time.sleep(0.01)
+        raise TimeoutError("TCPStore.barrier timed out")
+
+    def __del__(self):
+        try:
+            if self._lib is not None:
+                if getattr(self, "_client", None):
+                    self._lib.tcp_store_client_destroy(self._client)
+                if getattr(self, "_server", None):
+                    self._lib.tcp_store_server_destroy(self._server)
+        except Exception:
+            pass
+
+
+# -- pure-Python fallback ----------------------------------------------------
+
+
+class _PyStoreServer:
+    def __init__(self, port):
+        self._data = {}
+        self._cv = threading.Condition()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        def read_full(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            return buf
+
+        try:
+            while True:
+                op = read_full(1)[0]
+                klen = struct.unpack("<I", read_full(4))[0]
+                key = read_full(klen).decode()
+                if op == 1:    # SET
+                    vlen = struct.unpack("<I", read_full(4))[0]
+                    val = read_full(vlen)
+                    with self._cv:
+                        self._data[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(b"\x01")
+                elif op in (2, 4):  # GET / WAIT
+                    with self._cv:
+                        if op == 4:
+                            self._cv.wait_for(lambda: key in self._data)
+                        val = self._data.get(key)
+                    if val is None:
+                        conn.sendall(struct.pack("<I", 0xFFFFFFFF))
+                    else:
+                        conn.sendall(struct.pack("<I", len(val)) + val)
+                elif op == 3:  # ADD
+                    vlen = struct.unpack("<I", read_full(4))[0]
+                    inc = struct.unpack("<q", read_full(vlen))[0]
+                    with self._cv:
+                        cur = struct.unpack(
+                            "<q", self._data.get(key, b"\0" * 8))[0]
+                        out = cur + inc
+                        self._data[key] = struct.pack("<q", out)
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<q", out))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+class _PyStoreClient:
+    def __init__(self, host, port, timeout):
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def _read_full(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def set(self, key, data):
+        with self._lock:
+            kb = key.encode()
+            self._sock.sendall(bytes([1]) + struct.pack("<I", len(kb)) + kb
+                               + struct.pack("<I", len(data)) + data)
+            self._read_full(1)
+
+    def get(self, key, wait):
+        with self._lock:
+            kb = key.encode()
+            self._sock.sendall(bytes([4 if wait else 2])
+                               + struct.pack("<I", len(kb)) + kb)
+            ln = struct.unpack("<I", self._read_full(4))[0]
+            if ln == 0xFFFFFFFF:
+                raise KeyError(key)
+            return self._read_full(ln)
+
+    def add(self, key, amount):
+        with self._lock:
+            kb = key.encode()
+            self._sock.sendall(bytes([3]) + struct.pack("<I", len(kb)) + kb
+                               + struct.pack("<I", 8)
+                               + struct.pack("<q", amount))
+            return struct.unpack("<q", self._read_full(8))[0]

@@ -288,6 +288,8 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -324,7 +326,14 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        # threaded prefetch pipeline
+        if (self.use_shared_memory and not self._iterable_mode):
+            it = self._iter_multiprocess()
+            if it is not None:
+                yield from it
+                return
+        yield from self._iter_threaded()
+
+    def _iter_threaded(self):
         q: _queue.Queue = _queue.Queue(maxsize=self.prefetch_factor
                                        * self.num_workers)
         sentinel = object()
@@ -343,3 +352,79 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+    def _iter_multiprocess(self):
+        """Real worker processes over the native shared-memory ring queue
+        (csrc/shm_queue.cpp) — the C++ data-feed path.  Returns None when
+        the native transport is unavailable (caller falls back to threads).
+        """
+        try:
+            from .shm_queue import ShmQueue
+            out_q = ShmQueue(capacity=128 << 20)
+        except Exception:
+            return None
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        all_batches = list(self.batch_sampler)
+        nw = min(self.num_workers, max(len(all_batches), 1))
+        dataset = self.dataset
+        collate = self.collate_fn
+        init_fn = self.worker_init_fn
+        qname = out_q.name
+
+        def worker(wid):
+            from .shm_queue import ShmQueue as SQ
+            q = SQ(qname, create=False)
+            if init_fn is not None:
+                init_fn(wid)
+            for bi in range(wid, len(all_batches), nw):
+                idxs = all_batches[bi]
+                batch = collate([dataset[i] for i in idxs])
+                import numpy as _np
+                from ..core.tensor import Tensor as _T
+                import jax.tree_util as jtu
+                payload = jtu.tree_map(
+                    lambda t: _np.asarray(t._array) if isinstance(t, _T) else t,
+                    batch, is_leaf=lambda l: isinstance(l, _T))
+                q.put((bi, payload))
+            q.put(("done", wid))
+
+        procs = [ctx.Process(target=worker, args=(w,), daemon=True)
+                 for w in range(nw)]
+        for p in procs:
+            p.start()
+
+        def gen():
+            from ..core.tensor import Tensor as _T
+            import jax.tree_util as jtu
+            pending = {}
+            done = 0
+            nxt = 0
+            total = len(all_batches)
+            try:
+                while nxt < total:
+                    if nxt in pending:
+                        payload = pending.pop(nxt)
+                    else:
+                        tag, payload_or_wid = out_q.get()
+                        if tag == "done":
+                            done += 1
+                            if done == nw and nxt >= total:
+                                break
+                            continue
+                        if tag != nxt:
+                            pending[tag] = payload_or_wid
+                            continue
+                        payload = payload_or_wid
+                    nxt += 1
+                    yield jtu.tree_map(
+                        lambda a: _T(a) if hasattr(a, "dtype") else a, payload)
+            finally:
+                out_q.close()
+                for p in procs:
+                    p.join(timeout=2)
+                    if p.is_alive():
+                        p.terminate()
+                out_q.destroy()
+
+        return gen()

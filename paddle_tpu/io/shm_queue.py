@@ -1,0 +1,75 @@
+"""Shared-memory queue — Python interface over csrc/shm_queue.cpp.
+
+The native transport for multiprocess DataLoader workers (reference
+analogue: fluid/dataloader shared-memory mmap tensors + the C++
+BlockingQueue behind pybind/reader_py.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import uuid
+
+from ..core import native as _native
+
+
+class ShmQueue:
+    def __init__(self, name: str = None, capacity: int = 64 << 20,
+                 create: bool = True):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.name = name or f"/ptq_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        if create:
+            self._q = lib.shm_queue_create(self.name.encode(), capacity)
+        else:
+            self._q = lib.shm_queue_open(self.name.encode())
+        if not self._q:
+            raise RuntimeError(f"shm_queue init failed for {self.name}")
+        self._owner = create
+
+    def open_in_child(self):
+        """Re-open the mapping after fork/spawn (handle is per-process)."""
+        return ShmQueue(self.name, create=False)
+
+    def put(self, obj):
+        data = pickle.dumps(obj, protocol=4)
+        rc = self._lib.shm_queue_push(self._q, data, len(data))
+        if rc == -2:
+            raise ValueError(f"item of {len(data)} bytes exceeds queue capacity")
+        if rc != 0:
+            raise RuntimeError("queue closed")
+
+    def get(self, max_bytes: int = 256 << 20):
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.shm_queue_pop(self._q, buf, cap)
+            if n == -3:
+                cap = min(cap * 4, max_bytes)
+                continue
+            if n < 0:
+                raise EOFError("queue closed")
+            return pickle.loads(buf.raw[:n])
+
+    def qsize(self):
+        return int(self._lib.shm_queue_size(self._q))
+
+    def close(self):
+        if self._q:
+            self._lib.shm_queue_close(self._q)
+
+    def destroy(self):
+        if self._q:
+            self._lib.shm_queue_destroy(self._q)
+            self._q = None
+
+    def __getstate__(self):
+        return {"name": self.name}
+
+    def __setstate__(self, state):
+        fresh = ShmQueue(state["name"], create=False)
+        self.__dict__.update(fresh.__dict__)
+        self._owner = False
