@@ -90,8 +90,24 @@ class StoreServer {
     if (!stop_.compare_exchange_strong(expected, true)) return;
     if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR), ::close(listen_fd_);
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> lk(conn_mu_);
-    for (auto& t : conn_threads_)
+    // Wake WAIT-blocked threads (their predicate checks stop_) and unblock
+    // recv-blocked threads by shutting down every live connection; only then
+    // is join guaranteed to complete even with clients still attached.
+    {
+      // mu_ orders the stop_ store with a waiter between its predicate
+      // check and blocking — notify without it can be lost.
+      std::lock_guard<std::mutex> lk(mu_);
+    }
+    cv_.notify_all();
+    std::vector<std::thread> threads;
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conn_fds_)
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+      threads.swap(conn_threads_);
+    }
+    // join with conn_mu_ released: Serve()'s fd cleanup takes conn_mu_.
+    for (auto& t : threads)
       if (t.joinable()) t.join();
   }
 
@@ -103,6 +119,21 @@ class StoreServer {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(conn_mu_);
+      // reap threads whose connections already closed (their fd slot was
+      // tombstoned in Serve) so long-lived servers don't accumulate one
+      // dead std::thread + fd slot per connection ever accepted
+      for (size_t i = 0; i < conn_fds_.size();) {
+        if (conn_fds_[i] < 0) {
+          if (conn_threads_[i].joinable()) conn_threads_[i].join();
+          conn_fds_[i] = conn_fds_.back();
+          conn_fds_.pop_back();
+          std::swap(conn_threads_[i], conn_threads_.back());
+          conn_threads_.pop_back();
+        } else {
+          ++i;
+        }
+      }
+      conn_fds_.push_back(fd);
       conn_threads_.emplace_back([this, fd] { Serve(fd); });
     }
   }
@@ -193,6 +224,11 @@ class StoreServer {
         break;
       }
     }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (auto& f : conn_fds_)
+        if (f == fd) f = -1;
+    }
     ::close(fd);
   }
 
@@ -201,6 +237,7 @@ class StoreServer {
   std::atomic<bool> stop_;
   std::thread accept_thread_;
   std::mutex conn_mu_;
+  std::vector<int> conn_fds_;
   std::vector<std::thread> conn_threads_;
   std::mutex mu_;
   std::condition_variable cv_;

@@ -4,7 +4,8 @@ Blockwise-softmax attention with O(S) memory — the capability the reference
 lacks entirely (SURVEY.md §5.7: no flash/ring attention in the snapshot; its
 fused FMHA paddle/fluid/operators/fused/fmha_ref.h is still O(S^2)).
 
-v1 strategy: Pallas forward kernel + recompute-based backward via custom_vjp.
+Forward and backward are dedicated Pallas kernels (FlashAttention-2 style
+custom_vjp; see flash_attention_pallas.py).
 """
 from __future__ import annotations
 

@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels (forward + backward).
 
 Blockwise streaming-softmax attention (Flash-Attention style): the query
 block lives in VMEM, K/V are scanned block-by-block with running (max, sum,
@@ -6,8 +6,11 @@ acc) statistics in fp32, so score matrices never materialise in HBM —
 O(S) memory instead of the reference FMHA's O(S^2)
 (paddle/fluid/operators/fused/fmha_ref.h).
 
-v1 backward = recompute-based custom_vjp (XLA reference attention under
-jax.vjp); a dedicated Pallas backward kernel is a later optimisation.
+Backward is a pair of dedicated Pallas kernels (FlashAttention-2 style):
+* dQ kernel: grid over query blocks, scans key blocks, recomputes the
+  probability block from the saved logsumexp — no O(S^2) materialisation.
+* dK/dV kernel: grid over key blocks, scans query blocks.
+Both accumulate in fp32 and write grads in the input dtype.
 """
 from __future__ import annotations
 
@@ -16,15 +19,29 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
+def _i32(v):
+    return jnp.asarray(v, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                block_k):
     # q_ref: (1, BQ, D); k_ref/v_ref: (1, S, D); o_ref: (1, BQ, D)
+    # lse_ref: (1, NQ, BQ) — per-row logsumexp of the scaled (masked)
+    # logits, saved for the backward kernels.  The (NQ, BQ) layout is the
+    # (S,) row vector folded to satisfy TPU (8,128) tiling: the whole
+    # per-(b,h) slice stays resident across the sequential q-block grid
+    # steps and each step writes its own row.
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     s = k_ref.shape[1]
@@ -38,15 +55,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
 
-    # all index math in explicit-int32 lax ops: under jax x64 mode any
-    # python-int mixing can surface i64, which mosaic cannot lower
-    i32 = lambda v: jnp.asarray(v, jnp.int32)
-    row_ids = jax.lax.mul(qi, i32(block_q))[None, None] + \
+    row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
         jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(kb, carry):
         m, l, acc = carry
-        start = jax.lax.mul(kb, i32(block_k))
+        start = jax.lax.mul(kb, _i32(block_k))
         k = k_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
         logits = jax.lax.dot_general(
@@ -55,7 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
         if causal:
             col_ids = start[None, None] + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            logits = jnp.where(col_ids <= row_ids, logits, jnp.float32(_NEG_INF))
+            logits = jnp.where(col_ids <= row_ids, logits,
+                               jnp.float32(_NEG_INF))
         blk_max = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         correction = jnp.exp(m - new_m)
@@ -68,12 +83,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
 
     if causal:
         assert block_q % block_k == 0
-        num_kb = jax.lax.mul(jax.lax.add(qi, i32(1)),
-                             i32(block_q // block_k))
+        num_kb = jax.lax.mul(jax.lax.add(qi, _i32(1)),
+                             _i32(block_q // block_k))
     else:
-        num_kb = i32(s // block_k)
-    m, l, acc = jax.lax.fori_loop(i32(0), num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, jnp.float32(1e-30))[:, None]).astype(o_ref.dtype)
+        num_kb = _i32(s // block_k)
+    m, l, acc = jax.lax.fori_loop(_i32(0), num_kb, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, pl.ds(qi, 1), :] = (m + jnp.log(l_safe))[None, :]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret=False):
@@ -93,7 +110,7 @@ def _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k, interpret):
     nq = s // block_q
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq),
         in_specs=[
@@ -101,12 +118,195 @@ def _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, k3.shape[1], d), lambda bi, i: (bi, 0, 0)),
             pl.BlockSpec((1, v3.shape[1], d), lambda bi, i: (bi, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, nq, block_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d), lse  # lse stays (bh, nq, block_q)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, causal, scale, block_k):
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    s = k_ref.shape[1]
+    qi = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+
+    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)        # (BQ, D)
+    lse = lse_ref[0, pl.ds(qi, 1), :][0]      # (BQ,) f32
+    delta = delta_ref[0, pl.ds(qi, 1), :][0]  # (BQ,) f32
+
+    row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq_acc):
+        start = jax.lax.mul(kb, _i32(block_k))
+        k = k_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        logits = jnp.float32(scale) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])
+        if causal:
+            col_ids = start[None, None] + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(col_ids <= row_ids, p, jnp.float32(0.0))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        num_kb = jax.lax.mul(jax.lax.add(qi, _i32(1)),
+                             _i32(block_q // block_k))
+    else:
+        num_kb = _i32(s // block_k)
+    dq = jax.lax.fori_loop(_i32(0), num_kb, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (jnp.float32(scale) * dq).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, causal, scale, block_q):
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    s = q_ref.shape[1]
+    ki = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+
+    k = k_ref[0].astype(jnp.float32)          # (BK, D)
+    v = v_ref[0].astype(jnp.float32)          # (BK, D)
+
+    col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        start = jax.lax.mul(qb, _i32(block_q))
+        q = q_ref[0, pl.ds(start, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb, 1), :][0]
+        delta = delta_ref[0, pl.ds(qb, 1), :][0]
+        logits = jnp.float32(scale) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        p = jnp.exp(logits - lse[:, None])
+        if causal:
+            row_ids = start[None, None] + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(col_ids <= row_ids, p, jnp.float32(0.0))
+        # dV += P^T dO
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        ds = p * (dp - delta[:, None])
+        # dK += dS^T Q
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BK, D)
+        return dk_acc, dv_acc
+
+    if causal:
+        assert block_q % block_k == 0 or block_k % block_q == 0
+        # first query block that can see this key block
+        start_qb = jax.lax.div(jax.lax.mul(ki, _i32(block_k)),
+                               _i32(block_q))
+    else:
+        start_qb = _i32(0)
+    nq = _i32(s // block_q)
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, nq, body, (zeros, zeros))
+    dk_ref[0] = (jnp.float32(scale) * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+               interpret=False):
+    with jax.enable_x64(False):
+        return _flash_bwd_inner(q, k, v, o, lse, do, causal, scale,
+                                block_q, block_k, interpret)
+
+
+def _flash_bwd_inner(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret):
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    do3 = do.reshape(bh, s, d)
+    nq = s // block_q
+    nk = sk // block_k
+    lse3 = lse  # already (bh, nq, block_q), folded row layout
+    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA; same folded layout
+    delta3 = jnp.sum(do3.astype(jnp.float32) *
+                     o.reshape(bh, s, d).astype(jnp.float32),
+                     axis=-1).reshape(bh, nq, block_q)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(q3, k3, v3)
-    return out.reshape(b, h, s, d)
+    )(q3, k3, v3, do3, lse3, delta3)
 
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q),
+        grid=(bh, nk),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, s, d), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# reference + custom_vjp wiring
+# ---------------------------------------------------------------------------
 
 def _reference_bhsd(q, k, v, causal, scale):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -121,22 +321,19 @@ def _reference_bhsd(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-based backward: differentiate the XLA reference (remat'd so the
-    # S^2 score matrix only exists transiently inside the fused backward)
-    _, vjp = jax.vjp(
-        jax.checkpoint(lambda q_, k_, v_: _reference_bhsd(q_, k_, v_, causal, scale)),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                      interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -151,4 +348,10 @@ def flash_attention_bhsd(q, k, v, causal=False, scale=None,
     s = q.shape[2]
     block_q = min(block_q, s)
     block_k = min(block_k, k.shape[2])
+    if s % block_q or k.shape[2] % block_k:
+        raise ValueError(
+            "flash_attention: seq lengths (%d, %d) must be divisible by "
+            "block sizes (%d, %d) — ragged tails would be silently dropped; "
+            "use the XLA path (kernels.flash_attention.supported() gates "
+            "this)" % (s, k.shape[2], block_q, block_k))
     return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)
