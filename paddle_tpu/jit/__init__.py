@@ -160,7 +160,8 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 num_inputs: int = 1, in_shardings=None, donate=True):
+                 num_inputs: int = 1, in_shardings=None, donate=True,
+                 zero_stage: Optional[int] = None, zero_axis: str = "sdp"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -177,6 +178,61 @@ class TrainStep:
                         if k not in trainable}
         self.opt_state = optimizer.init_state(self.params)
         self._dirty = True
+
+        # ---- ZeRO placement (reference semantics: sharding_stage2.py:43
+        # grad reduce-scatter, sharding_stage3.py:50 param slicing;
+        # TPU-native: shardings + GSPMD, SURVEY.md §7 table) ----------------
+        self._zero_stage = zero_stage
+        self._zero_axis = zero_axis
+        self._param_specs = None
+        self._grad_specs = None
+        self._in_shardings = in_shardings
+        if zero_stage:
+            from ..distributed import mesh as _mesh
+            from ..distributed.sharding import _stage_spec_for
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh = _mesh.ensure_mesh()
+            if _mesh.axis_size(zero_axis) <= 1 and mesh.size > 1:
+                raise ValueError(
+                    "zero_stage=%d requested but mesh axis %r has size <= 1 "
+                    "(mesh axes: %s) — init_mesh({'%s': N, ...}) first or "
+                    "the sharding would silently be a no-op"
+                    % (zero_stage, zero_axis, dict(
+                        zip(mesh.axis_names, mesh.devices.shape)),
+                       zero_axis))
+            shard = lambda a: _stage_spec_for(a, zero_axis)
+            # stage >=1: optimizer slots sharded
+            def place_slot(x):
+                if hasattr(x, "ndim") and x.ndim > 0:
+                    return jax.device_put(
+                        x, NamedSharding(mesh, shard(x)))
+                return x
+            self.opt_state = jax.tree_util.tree_map(place_slot,
+                                                    self.opt_state)
+            # stage >=2: grads reduce-scattered onto the same layout
+            if zero_stage >= 2:
+                self._grad_specs = {k: shard(v)
+                                    for k, v in self.params.items()}
+            # stage 3: parameters themselves sharded (allgather-on-use)
+            if zero_stage >= 3:
+                self._param_specs = {k: shard(v)
+                                     for k, v in self.params.items()}
+                self.params = {
+                    k: jax.device_put(
+                        v, NamedSharding(mesh, self._param_specs[k]))
+                    for k, v in self.params.items()}
+            else:
+                self.params = {
+                    k: jax.device_put(
+                        v, NamedSharding(mesh, PartitionSpec()))
+                    for k, v in self.params.items()}
+            self._mesh = mesh
+        elif in_shardings is not None:
+            from ..distributed import mesh as _mesh
+            self._mesh = _mesh.ensure_mesh()
+        else:
+            self._mesh = None
 
         def loss_core(params, buffers, rng, batch):
             state = {**params, **buffers}
@@ -200,8 +256,24 @@ class TrainStep:
         def step_fn(params, buffers, opt_state, lr, rng, batch):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_core, has_aux=True)(params, buffers, rng, batch)
+            if self._grad_specs is not None:
+                # ZeRO stage-2: constrain each grad to the slot layout so
+                # GSPMD lowers the data-parallel grad sum to reduce-scatter
+                # (sharding_stage2.py:43 semantics)
+                from jax.sharding import NamedSharding
+                grads = {
+                    k: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(self._mesh, self._grad_specs[k]))
+                    for k, g in grads.items()}
             new_params, new_opt_state = self.optimizer.apply_gradients(
                 params, grads, opt_state, lr)
+            if self._param_specs is not None:
+                # ZeRO stage-3: updated params stay sharded
+                from jax.sharding import NamedSharding
+                new_params = {
+                    k: jax.lax.with_sharding_constraint(
+                        p, NamedSharding(self._mesh, self._param_specs[k]))
+                    for k, p in new_params.items()}
             return loss, new_params, new_buffers, new_opt_state
 
         donate_args = (0, 1, 2) if donate else ()
@@ -211,6 +283,14 @@ class TrainStep:
         rng = _rnd.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch_a = _unwrap_tree(batch)
+        if self._in_shardings is not None and self._mesh is not None:
+            from jax.sharding import NamedSharding
+            specs = self._in_shardings
+            if not isinstance(specs, (list, tuple)):
+                specs = [specs] * len(batch_a)
+            batch_a = tuple(
+                jax.device_put(b, NamedSharding(self._mesh, s))
+                for b, s in zip(batch_a, specs))
         loss, self.params, self.buffers, self.opt_state = self._step(
             self.params, self.buffers, self.opt_state, lr, rng, batch_a)
         self._dirty = True

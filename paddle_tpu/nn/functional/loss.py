@@ -25,7 +25,9 @@ def _reduce(out, reduction, weight_sum=None):
 
 def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
                                    ignore_index=-100, axis=-1):
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    # f32 softmax statistics regardless of logits dtype (bf16 logits over a
+    # 50k vocab lose the tail mass); XLA fuses the convert into the reduce
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if soft_label:
         return -jnp.sum(label * logp, axis=axis)
     lbl = label

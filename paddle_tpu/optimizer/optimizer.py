@@ -27,7 +27,7 @@ from .lr import LRScheduler
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None, multi_precision=False):
+                 grad_clip=None, name=None, multi_precision=None):
         if parameters is None:
             raise ValueError(
                 "parameters=None: pass model.parameters() (static-graph "
@@ -41,6 +41,40 @@ class Optimizer:
         self._step_count = 0
         self._jit_update = None
         self._name = name or type(self).__name__
+        # fp32 master weights for low-precision params (reference:
+        # optimizer.py _multi_precision + fluid/dygraph/amp/loss_scaler.py:40).
+        # None = auto: on whenever a param is bf16/fp16 — without a master
+        # copy, lr~1e-4 updates on O2 bf16 weights vanish below the bf16 ULP.
+        self._multi_precision = multi_precision
+
+    def _wants_master(self, p) -> bool:
+        if self._multi_precision is False:
+            return False
+        return p.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _init_slots(self, p):
+        slots = self.init_one(p)
+        if self._wants_master(p):
+            # all slots f32 from step 0: the master-path update returns f32
+            # slots, and a dtype flip between steps would silently retrace
+            # the compiled train step and break buffer donation
+            slots = {k: v.astype(jnp.float32)
+                     if hasattr(v, "dtype") and jnp.issubdtype(
+                         v.dtype, jnp.floating) else v
+                     for k, v in slots.items()}
+            slots["master"] = p.astype(jnp.float32)
+        return slots
+
+    def _update_leaf(self, g, p, slots, lr, step):
+        """update_one, routed through the fp32 master copy when present."""
+        master = slots.get("master") if isinstance(slots, dict) else None
+        if master is None:
+            return self.update_one(g, p, slots, lr, step)
+        inner = {k: v for k, v in slots.items() if k != "master"}
+        new_master, new_inner = self.update_one(
+            g.astype(jnp.float32), master, inner, lr, step)
+        new_inner["master"] = new_master
+        return new_master.astype(p.dtype), new_inner
 
     @staticmethod
     def _coeff(weight_decay):
@@ -90,9 +124,8 @@ class Optimizer:
 
     # -- compiled-path API ---------------------------------------------------
     def init_state(self, params_tree):
-        leaves = jax.tree_util.tree_leaves(params_tree)
         return {
-            "slots": jax.tree_util.tree_map(self.init_one, params_tree),
+            "slots": jax.tree_util.tree_map(self._init_slots, params_tree),
             "step": jnp.zeros((), jnp.int32),
         }
 
@@ -113,7 +146,7 @@ class Optimizer:
                 new_p.append(p)
                 new_slots.append(s)
                 continue
-            np_, ns = self.update_one(g, p, s, lr, step)
+            np_, ns = self._update_leaf(g, p, s, lr, step)
             new_p.append(np_)
             new_slots.append(ns)
         params_out = jax.tree_util.tree_unflatten(treedef, new_p)
@@ -161,13 +194,13 @@ class Optimizer:
             self._jit_key = key
             for p in params:
                 if id(p) not in self._accumulators:
-                    self._accumulators[id(p)] = self.init_one(p._array)
+                    self._accumulators[id(p)] = self._init_slots(p._array)
 
             def _update(p_arrs, g_arrs, slot_list, lr, step):
                 g_arrs = self._clip_tree(p_arrs, list(g_arrs))
                 new_p, new_s = [], []
                 for p, g, s in zip(p_arrs, g_arrs, slot_list):
-                    np_, ns = self.update_one(g, p, s, lr, step)
+                    np_, ns = self._update_leaf(g, p, s, lr, step)
                     new_p.append(np_)
                     new_s.append(ns)
                 return new_p, new_s

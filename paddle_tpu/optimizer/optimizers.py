@@ -21,7 +21,7 @@ def _wd_grad(self, g, p):
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None, multi_precision=False):
+                 grad_clip=None, name=None, multi_precision=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
 
@@ -33,7 +33,7 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None, multi_precision=False):
+                 name=None, multi_precision=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._momentum = momentum
@@ -57,7 +57,7 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, name=None,
-                 multi_precision=False, amsgrad=False):
+                 multi_precision=None, amsgrad=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
@@ -101,7 +101,7 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None,
+                 lazy_mode=False, multi_precision=None, name=None,
                  amsgrad=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, name,
