@@ -1,0 +1,791 @@
+"""OpTest-scale operator harness.
+
+The TPU-native analogue of the reference's OpTest fixture
+(python/paddle/fluid/tests/unittests/op_test.py:289): every op in the table
+below is checked for
+  (a) forward parity against a numpy/scipy golden reference in float32,
+  (b) forward parity in bfloat16 with bf16-appropriate tolerances
+      (op_test.py's FP16/BF16 variants + white-list tolerance policy),
+  (c) analytic-vs-numeric gradients (op_test.py check_grad_with_place:1830).
+
+Instead of the reference's O(numel) per-element central differences, grads are
+validated by directional derivatives: for a random unit direction v,
+  (L(x + eps*v) - L(x - eps*v)) / (2*eps)  ==  <dL/dx, v>
+which is 2 evaluations per input at any size.  bf16 gradients are checked
+against the f32 analytic gradient (the reference's bf16 tolerance policy).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+ml_dtypes = pytest.importorskip("ml_dtypes")
+BF16 = ml_dtypes.bfloat16
+
+RNG = np.random.RandomState(20240722)
+
+
+def T(a, stop_gradient=True):
+    return paddle.to_tensor(a, stop_gradient=stop_gradient)
+
+
+class Spec:
+    """One op's test spec.
+
+    make() -> (list_of_np_inputs, kwargs); ref(*inputs, **kwargs) -> np output
+    (or tuple of outputs; None entries in a ref tuple are skipped).
+    grad: indices of inputs to grad-check (empty = no grad check).
+    bf16: run the bf16 forward-parity variant.
+    """
+
+    def __init__(self, name, make, ref, fn=None, grad=(), bf16=True,
+                 rtol=1e-4, atol=1e-5, bf16_rtol=5e-2, bf16_atol=5e-2,
+                 grad_rtol=2e-2, grad_atol=2e-3):
+        self.name = name
+        self.make = make
+        self.ref = ref
+        self.fn = fn or name
+        self.grad = tuple(grad)
+        self.bf16 = bf16
+        self.rtol, self.atol = rtol, atol
+        self.bf16_rtol, self.bf16_atol = bf16_rtol, bf16_atol
+        self.grad_rtol, self.grad_atol = grad_rtol, grad_atol
+
+    def op(self):
+        fn = self.fn
+        if callable(fn):
+            return fn
+        return getattr(paddle, fn)
+
+    def __repr__(self):
+        return self.name
+
+
+def _run_op(spec, np_inputs, kwargs):
+    tensors = [T(a) if isinstance(a, np.ndarray) else a for a in np_inputs]
+    out = spec.op()(*tensors, **kwargs)
+    return out
+
+
+def _as_np_outputs(out):
+    if isinstance(out, (tuple, list)):
+        return [o.numpy() if hasattr(o, "numpy") else np.asarray(o)
+                for o in out]
+    return [out.numpy() if hasattr(out, "numpy") else np.asarray(out)]
+
+
+def _check_parity(spec, dtype):
+    np_inputs, kwargs = spec.make()
+    cast = []
+    for a in np_inputs:
+        if isinstance(a, np.ndarray) and a.dtype in (np.float32, np.float64):
+            cast.append(a.astype(dtype))
+        else:
+            cast.append(a)
+    got = _as_np_outputs(_run_op(spec, cast, kwargs))
+    # golden reference always evaluated in f64 for accuracy (op_test.py
+    # computes numpy refs at full precision)
+    ref_inputs = [a.astype(np.float64)
+                  if isinstance(a, np.ndarray) and a.dtype in
+                  (np.float32, np.float64, BF16) else a for a in np_inputs]
+    want = spec.ref(*ref_inputs, **kwargs)
+    if not isinstance(want, (tuple, list)):
+        want = [want]
+    want = list(want)
+    assert len(got) >= len([w for w in want if w is not None]), \
+        f"{spec.name}: {len(got)} outputs vs {len(want)} refs"
+    if dtype == np.float32:
+        rtol, atol = spec.rtol, spec.atol
+    else:
+        rtol, atol = spec.bf16_rtol, spec.bf16_atol
+    for g, w in zip(got, want):
+        if w is None:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(g, dtype=np.float64), np.asarray(w, dtype=np.float64),
+            rtol=rtol, atol=atol, err_msg=f"{spec.name} [{dtype}]")
+
+
+def _scalar_loss(spec, np_inputs, kwargs, diff_idx, weights):
+    """Weighted sum over float outputs — the probe functional for grad checks.
+
+    A fixed random weighting (not plain sum) so ops whose adjoint mixes
+    components (sort, matmul, ...) are still sensitively probed.
+    """
+    tensors = []
+    for i, a in enumerate(np_inputs):
+        if isinstance(a, np.ndarray):
+            tensors.append(T(a, stop_gradient=i not in diff_idx))
+        else:
+            tensors.append(a)
+    out = spec.op()(*tensors, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    wi = 0
+    for o in outs:
+        if not hasattr(o, "numpy"):
+            continue
+        if o.dtype not in (np.float32, np.float16, BF16, np.float64):
+            continue
+        term = (o.astype("float32") * T(weights[wi])).sum()
+        wi += 1
+        loss = term if loss is None else loss + term
+    return loss, tensors
+
+
+def _check_grad(spec):
+    np_inputs, kwargs = spec.make()
+    np_inputs = [a.astype(np.float32) if isinstance(a, np.ndarray)
+                 and a.dtype in (np.float64,) else a for a in np_inputs]
+    diff_idx = spec.grad
+    # fixed weights per output, built from a dry run
+    out = _run_op(spec, np_inputs, kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    weights = []
+    for o in outs:
+        if hasattr(o, "numpy") and o.dtype in (np.float32, np.float16, BF16,
+                                               np.float64):
+            weights.append(RNG.uniform(0.5, 1.5,
+                                       size=tuple(o.shape)).astype(np.float32))
+    loss, tensors = _scalar_loss(spec, np_inputs, kwargs, diff_idx, weights)
+    assert loss is not None, f"{spec.name}: no float output to differentiate"
+    loss.backward()
+    eps = 1e-3
+    for i in diff_idx:
+        g = tensors[i].grad
+        assert g is not None, f"{spec.name}: no grad for input {i}"
+        g = g.numpy().astype(np.float64)
+        v = RNG.standard_normal(np_inputs[i].shape)
+        v /= max(np.linalg.norm(v), 1e-12)
+        plus = [a.copy() if isinstance(a, np.ndarray) else a
+                for a in np_inputs]
+        minus = [a.copy() if isinstance(a, np.ndarray) else a
+                 for a in np_inputs]
+        plus[i] = (plus[i].astype(np.float64) + eps * v).astype(np.float32)
+        minus[i] = (minus[i].astype(np.float64) - eps * v).astype(np.float32)
+        lp, _ = _scalar_loss(spec, plus, kwargs, (), weights)
+        lm, _ = _scalar_loss(spec, minus, kwargs, (), weights)
+        numeric = (float(lp.numpy()) - float(lm.numpy())) / (2 * eps)
+        analytic = float((g * v).sum())
+        scale = max(abs(numeric), abs(analytic), 1.0)
+        assert abs(numeric - analytic) <= spec.grad_rtol * scale + \
+            spec.grad_atol, (
+                f"{spec.name}: directional grad mismatch input {i}: "
+                f"numeric={numeric:.6g} analytic={analytic:.6g}")
+
+
+# ---------------------------------------------------------------------------
+# input factories
+# ---------------------------------------------------------------------------
+def fmat(*shape, lo=-1.0, hi=1.0):
+    def make():
+        return [RNG.uniform(lo, hi, size=shape).astype(np.float32)], {}
+    return make
+
+
+def fmat2(*shape, lo=-1.0, hi=1.0):
+    def make():
+        return [RNG.uniform(lo, hi, size=shape).astype(np.float32),
+                RNG.uniform(lo, hi, size=shape).astype(np.float32)], {}
+    return make
+
+
+def fpos(*shape, lo=0.2, hi=2.0):
+    return fmat(*shape, lo=lo, hi=hi)
+
+
+def fpos2(*shape, lo=0.2, hi=2.0):
+    return fmat2(*shape, lo=lo, hi=hi)
+
+
+def with_kw(make, **kw):
+    def m():
+        inputs, kwargs = make()
+        kwargs = dict(kwargs, **kw)
+        return inputs, kwargs
+    return m
+
+
+def imat(*shape, lo=0, hi=10):
+    def make():
+        return [RNG.randint(lo, hi, size=shape).astype(np.int64)], {}
+    return make
+
+
+# numpy helpers
+def np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_rsqrt(x):
+    return 1.0 / np.sqrt(x)
+
+
+import scipy.special as sps  # noqa: E402
+import scipy.linalg  # noqa: E402
+
+
+SPECS = [
+    # ---- unary float math ------------------------------------------------
+    Spec("exp", fmat(3, 4), np.exp, grad=(0,)),
+    Spec("expm1", fmat(3, 4), np.expm1, grad=(0,)),
+    Spec("log", fpos(3, 4), np.log, grad=(0,)),
+    Spec("log1p", fpos(3, 4), np.log1p, grad=(0,)),
+    Spec("log2", fpos(3, 4), np.log2, grad=(0,)),
+    Spec("log10", fpos(3, 4), np.log10, grad=(0,)),
+    Spec("sqrt", fpos(3, 4), np.sqrt, grad=(0,)),
+    Spec("rsqrt", fpos(3, 4), np_rsqrt, grad=(0,)),
+    Spec("abs", fmat(3, 4), np.abs, grad=(0,)),
+    Spec("neg", fmat(3, 4), np.negative, grad=(0,)),
+    Spec("sin", fmat(3, 4), np.sin, grad=(0,)),
+    Spec("cos", fmat(3, 4), np.cos, grad=(0,)),
+    Spec("tan", fmat(3, 4), np.tan, grad=(0,)),
+    Spec("asin", fmat(3, 4, lo=-0.9, hi=0.9), np.arcsin, grad=(0,)),
+    Spec("acos", fmat(3, 4, lo=-0.9, hi=0.9), np.arccos, grad=(0,)),
+    Spec("atan", fmat(3, 4), np.arctan, grad=(0,)),
+    Spec("sinh", fmat(3, 4), np.sinh, grad=(0,)),
+    Spec("cosh", fmat(3, 4), np.cosh, grad=(0,)),
+    Spec("tanh", fmat(3, 4), np.tanh, grad=(0,)),
+    Spec("asinh", fmat(3, 4), np.arcsinh, grad=(0,)),
+    Spec("acosh", fpos(3, 4, lo=1.2, hi=3.0), np.arccosh, grad=(0,)),
+    Spec("atanh", fmat(3, 4, lo=-0.8, hi=0.8), np.arctanh, grad=(0,)),
+    Spec("sigmoid", fmat(3, 4), np_sigmoid, grad=(0,)),
+    Spec("square", fmat(3, 4), np.square, grad=(0,)),
+    Spec("reciprocal", fpos(3, 4), np.reciprocal, grad=(0,)),
+    Spec("erf", fmat(3, 4), sps.erf, grad=(0,)),
+    Spec("erfinv", fmat(3, 4, lo=-0.8, hi=0.8), sps.erfinv, grad=(0,),
+         bf16_atol=0.1),
+    Spec("lgamma", fpos(3, 4, lo=0.5, hi=3.0), sps.gammaln, grad=(0,)),
+    Spec("digamma", fpos(3, 4, lo=0.5, hi=3.0), sps.digamma, grad=(0,)),
+    Spec("polygamma", with_kw(fpos(3, 4, lo=0.5, hi=3.0), n=1),
+         lambda x, n: sps.polygamma(n, x), bf16=False),
+    Spec("i0", fmat(3, 4), sps.i0, grad=(0,)),
+    Spec("i1", fmat(3, 4), sps.i1, grad=(0,)),
+    Spec("ceil", fmat(3, 4, lo=-3, hi=3), np.ceil),
+    Spec("floor", fmat(3, 4, lo=-3, hi=3), np.floor),
+    Spec("round", fmat(3, 4, lo=-3, hi=3), np.round),
+    Spec("trunc", fmat(3, 4, lo=-3, hi=3), np.trunc),
+    Spec("frac", fmat(3, 4, lo=-3, hi=3),
+         lambda x: x - np.trunc(x), grad=(0,)),
+    Spec("sign", fmat(3, 4), np.sign),
+    Spec("sgn", fmat(3, 4), np.sign),
+    Spec("deg2rad", fmat(3, 4, lo=-180, hi=180), np.deg2rad, grad=(0,),
+         bf16_rtol=1e-1),
+    Spec("rad2deg", fmat(3, 4), np.rad2deg, grad=(0,), bf16_rtol=1e-1,
+         bf16_atol=0.5),
+    Spec("angle", fmat(3, 4), np.angle),
+    Spec("conj", fmat(3, 4), np.conj, grad=(0,)),
+    Spec("stanh", with_kw(fmat(3, 4), scale_a=0.67, scale_b=1.7159),
+         lambda x, scale_a, scale_b: scale_b * np.tanh(scale_a * x),
+         grad=(0,)),
+    Spec("scale", with_kw(fmat(3, 4), scale=2.5, bias=0.5),
+         lambda x, scale, bias: x * scale + bias, grad=(0,)),
+    Spec("clip", with_kw(fmat(3, 4), min=-0.3, max=0.4),
+         lambda x, min, max: np.clip(x, min, max), grad=(0,)),
+    Spec("nan_to_num", lambda: ([np.array([[1.0, np.nan],
+                                           [np.inf, -np.inf]],
+                                          np.float32)], {}),
+         lambda x: np.nan_to_num(x.astype(np.float32), posinf=None,
+                                 neginf=None),
+         bf16=False, rtol=1e-6, atol=0),
+    Spec("logit", fmat(3, 4, lo=0.1, hi=0.9), sps.logit,
+         fn=lambda x: paddle.log(x / (1 - x)), grad=(0,)),
+    # ---- binary ----------------------------------------------------------
+    Spec("add", fmat2(3, 4), np.add, grad=(0, 1)),
+    Spec("subtract", fmat2(3, 4), np.subtract, grad=(0, 1)),
+    Spec("multiply", fmat2(3, 4), np.multiply, grad=(0, 1)),
+    Spec("divide", fpos2(3, 4), np.divide, grad=(0, 1)),
+    Spec("pow", fpos2(3, 4), np.power, grad=(0, 1)),
+    Spec("maximum", fmat2(3, 4), np.maximum, grad=(0, 1)),
+    Spec("minimum", fmat2(3, 4), np.minimum, grad=(0, 1)),
+    Spec("fmax", fmat2(3, 4), np.fmax, grad=(0, 1)),
+    Spec("fmin", fmat2(3, 4), np.fmin, grad=(0, 1)),
+    Spec("mod", fpos2(3, 4), np.mod, bf16=False),
+    Spec("remainder", fpos2(3, 4), np.remainder, bf16=False),
+    Spec("floor_mod", fpos2(3, 4), np.mod, bf16=False),
+    Spec("floor_divide", fpos2(3, 4, lo=1.0, hi=4.0), np.floor_divide),
+    Spec("atan2", fmat2(3, 4), np.arctan2, grad=(0, 1)),
+    Spec("hypot", fmat2(3, 4), np.hypot, grad=(0, 1)),
+    Spec("logaddexp", fmat2(3, 4), np.logaddexp, grad=(0, 1)),
+    Spec("copysign", fmat2(3, 4), np.copysign),
+    Spec("heaviside", fmat2(3, 4), np.heaviside),
+    Spec("nextafter", fmat2(3, 4), np.nextafter, bf16=False, rtol=1e-6),
+    Spec("ldexp", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                            RNG.randint(-3, 3, (3, 4)).astype(np.int32)], {}),
+         lambda x, e: np.ldexp(x, e.astype(np.int64)), bf16=False),
+    Spec("lerp", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                           RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                           np.float32(0.3)], {}),
+         lambda x, y, w: x + w * (y - x), grad=(0, 1)),
+    Spec("gcd", lambda: ([RNG.randint(1, 50, (6,)).astype(np.int64),
+                          RNG.randint(1, 50, (6,)).astype(np.int64)], {}),
+         np.gcd, bf16=False),
+    Spec("lcm", lambda: ([RNG.randint(1, 20, (6,)).astype(np.int64),
+                          RNG.randint(1, 20, (6,)).astype(np.int64)], {}),
+         np.lcm, bf16=False),
+    # ---- matmul family ---------------------------------------------------
+    Spec("matmul", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                             RNG.uniform(-1, 1, (4, 5)).astype(np.float32)],
+                            {}),
+         np.matmul, grad=(0, 1), bf16_rtol=0.1),
+    Spec("mm", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                         RNG.uniform(-1, 1, (4, 5)).astype(np.float32)], {}),
+         np.matmul, grad=(0, 1), bf16_rtol=0.1),
+    Spec("bmm", lambda: ([RNG.uniform(-1, 1, (2, 3, 4)).astype(np.float32),
+                          RNG.uniform(-1, 1, (2, 4, 5)).astype(np.float32)],
+                         {}),
+         np.matmul, grad=(0, 1), bf16_rtol=0.1),
+    Spec("dot", fmat2(6), np.dot, grad=(0, 1)),
+    Spec("inner", fmat2(6), np.inner, grad=(0, 1)),
+    Spec("outer", lambda: ([RNG.uniform(-1, 1, (3,)).astype(np.float32),
+                            RNG.uniform(-1, 1, (4,)).astype(np.float32)], {}),
+         np.outer, grad=(0, 1)),
+    Spec("mv", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                         RNG.uniform(-1, 1, (4,)).astype(np.float32)], {}),
+         np.matmul, grad=(0, 1)),
+    Spec("addmm", lambda: ([RNG.uniform(-1, 1, (3, 5)).astype(np.float32),
+                            RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                            RNG.uniform(-1, 1, (4, 5)).astype(np.float32)],
+                           {"alpha": 0.7, "beta": 1.3}),
+         lambda inp, x, y, alpha, beta: beta * inp + alpha * (x @ y),
+         grad=(0, 1, 2), bf16_rtol=0.1),
+    Spec("kron", lambda: ([RNG.uniform(-1, 1, (2, 3)).astype(np.float32),
+                           RNG.uniform(-1, 1, (3, 2)).astype(np.float32)], {}),
+         np.kron, grad=(0, 1)),
+    Spec("cross", lambda: ([RNG.uniform(-1, 1, (4, 3)).astype(np.float32),
+                            RNG.uniform(-1, 1, (4, 3)).astype(np.float32)],
+                           {"axis": 1}),
+         lambda x, y, axis: np.cross(x, y, axis=axis), grad=(0, 1)),
+    Spec("multi_dot", lambda: ([
+        [RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+         RNG.uniform(-1, 1, (4, 5)).astype(np.float32),
+         RNG.uniform(-1, 1, (5, 2)).astype(np.float32)]], {}),
+         lambda ms: np.linalg.multi_dot(ms),
+         fn=lambda ms: paddle.multi_dot([T(m) if isinstance(m, np.ndarray)
+                                         else m for m in ms]),
+         bf16=False),
+    Spec("tensordot", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                                RNG.uniform(-1, 1, (4, 5)).astype(np.float32)],
+                               {"axes": 1}),
+         lambda x, y, axes: np.tensordot(x, y, axes=axes), grad=(0, 1),
+         bf16_rtol=0.1),
+    Spec("einsum", lambda: ([RNG.uniform(-1, 1, (3, 4)).astype(np.float32),
+                             RNG.uniform(-1, 1, (4, 5)).astype(np.float32)],
+                            {}),
+         lambda x, y: np.einsum("ij,jk->ik", x, y),
+         fn=lambda x, y: paddle.einsum("ij,jk->ik", x, y), grad=(0, 1),
+         bf16_rtol=0.1),
+    # ---- reductions ------------------------------------------------------
+    Spec("sum", with_kw(fmat(3, 4), axis=1), lambda x, axis: x.sum(axis),
+         grad=(0,)),
+    Spec("mean", with_kw(fmat(3, 4), axis=0), lambda x, axis: x.mean(axis),
+         grad=(0,)),
+    Spec("prod", with_kw(fpos(3, 4), axis=1),
+         lambda x, axis: x.prod(axis), grad=(0,), bf16_rtol=0.1),
+    Spec("max", with_kw(fmat(3, 4), axis=1), lambda x, axis: x.max(axis),
+         grad=(0,)),
+    Spec("min", with_kw(fmat(3, 4), axis=1), lambda x, axis: x.min(axis),
+         grad=(0,)),
+    Spec("amax", with_kw(fmat(3, 4), axis=1), lambda x, axis: x.max(axis),
+         grad=(0,)),
+    Spec("amin", with_kw(fmat(3, 4), axis=1), lambda x, axis: x.min(axis),
+         grad=(0,)),
+    Spec("std", fmat(3, 4), lambda x: x.std(ddof=1)),
+    Spec("var", fmat(3, 4), lambda x: x.var(ddof=1)),
+    Spec("median", fmat(3, 5), np.median),
+    Spec("nanmean", lambda: ([np.where(RNG.rand(3, 4) > 0.7, np.nan,
+                                       RNG.rand(3, 4)).astype(np.float32)],
+                             {}),
+         np.nanmean, bf16=False),
+    Spec("nansum", lambda: ([np.where(RNG.rand(3, 4) > 0.7, np.nan,
+                                      RNG.rand(3, 4)).astype(np.float32)],
+                            {}),
+         np.nansum, bf16=False),
+    Spec("nanmedian", lambda: ([np.where(RNG.rand(3, 5) > 0.7, np.nan,
+                                         RNG.rand(3, 5)).astype(np.float32)],
+                               {}),
+         np.nanmedian, bf16=False),
+    Spec("logsumexp", with_kw(fmat(3, 4), axis=1),
+         lambda x, axis: sps.logsumexp(x, axis=axis), grad=(0,)),
+    Spec("logcumsumexp", with_kw(fmat(3, 4), axis=1),
+         lambda x, axis: np.log(np.cumsum(np.exp(x), axis=axis)), grad=(0,)),
+    Spec("cumsum", with_kw(fmat(3, 4), axis=1),
+         lambda x, axis: np.cumsum(x, axis=axis), grad=(0,)),
+    Spec("cumprod", with_kw(fpos(3, 4), dim=1),
+         lambda x, dim: np.cumprod(x, axis=dim), grad=(0,), bf16_rtol=0.1),
+    Spec("cummax", with_kw(fmat(3, 4), axis=1),
+         lambda x, axis: (np.maximum.accumulate(x, axis=axis), None)),
+    Spec("cummin", with_kw(fmat(3, 4), axis=1),
+         lambda x, axis: (np.minimum.accumulate(x, axis=axis), None)),
+    Spec("count_nonzero", lambda: ([np.array([[0, 1, 2], [0, 0, 3]],
+                                             np.float32)], {}),
+         np.count_nonzero, bf16=False),
+    Spec("diff", with_kw(fmat(3, 5), axis=1),
+         lambda x, axis: np.diff(x, axis=axis), grad=(0,)),
+    Spec("trapezoid", fmat(3, 5),
+         lambda y: np.trapz(y, axis=-1), grad=(0,)),
+    Spec("quantile", with_kw(fmat(3, 8), q=0.5, axis=1),
+         lambda x, q, axis: np.quantile(x, q, axis=axis), bf16=False),
+    Spec("norm", fmat(3, 4), lambda x: np.linalg.norm(x), grad=(0,)),
+    Spec("dist", fmat2(3, 4),
+         lambda x, y: np.linalg.norm((x - y).ravel()), grad=(0, 1)),
+    # ---- comparison / logical / bitwise ---------------------------------
+    Spec("equal", fmat2(3, 4), np.equal, bf16=False),
+    Spec("not_equal", fmat2(3, 4), np.not_equal, bf16=False),
+    Spec("greater_than", fmat2(3, 4), np.greater, bf16=False),
+    Spec("greater_equal", fmat2(3, 4), np.greater_equal, bf16=False),
+    Spec("less_than", fmat2(3, 4), np.less, bf16=False),
+    Spec("less_equal", fmat2(3, 4), np.less_equal, bf16=False),
+    Spec("isclose", fmat2(3, 4), np.isclose, bf16=False),
+    Spec("allclose", fmat2(3, 4), np.allclose, bf16=False),
+    Spec("isfinite", lambda: ([np.array([1.0, np.inf, np.nan],
+                                        np.float32)], {}),
+         np.isfinite, bf16=False),
+    Spec("isinf", lambda: ([np.array([1.0, np.inf, np.nan], np.float32)], {}),
+         np.isinf, bf16=False),
+    Spec("isnan", lambda: ([np.array([1.0, np.inf, np.nan], np.float32)], {}),
+         np.isnan, bf16=False),
+    Spec("logical_and", lambda: ([(RNG.rand(3, 4) > 0.5),
+                                  (RNG.rand(3, 4) > 0.5)], {}),
+         np.logical_and, bf16=False),
+    Spec("logical_or", lambda: ([(RNG.rand(3, 4) > 0.5),
+                                 (RNG.rand(3, 4) > 0.5)], {}),
+         np.logical_or, bf16=False),
+    Spec("logical_xor", lambda: ([(RNG.rand(3, 4) > 0.5),
+                                  (RNG.rand(3, 4) > 0.5)], {}),
+         np.logical_xor, bf16=False),
+    Spec("logical_not", lambda: ([(RNG.rand(3, 4) > 0.5)], {}),
+         np.logical_not, bf16=False),
+    Spec("bitwise_and", lambda: ([RNG.randint(0, 16, (5,)).astype(np.int32),
+                                  RNG.randint(0, 16, (5,)).astype(np.int32)],
+                                 {}),
+         np.bitwise_and, bf16=False),
+    Spec("bitwise_or", lambda: ([RNG.randint(0, 16, (5,)).astype(np.int32),
+                                 RNG.randint(0, 16, (5,)).astype(np.int32)],
+                                {}),
+         np.bitwise_or, bf16=False),
+    Spec("bitwise_xor", lambda: ([RNG.randint(0, 16, (5,)).astype(np.int32),
+                                  RNG.randint(0, 16, (5,)).astype(np.int32)],
+                                 {}),
+         np.bitwise_xor, bf16=False),
+    Spec("bitwise_not", lambda: ([RNG.randint(0, 16, (5,)).astype(np.int32)],
+                                 {}),
+         np.bitwise_not, bf16=False),
+    Spec("bitwise_left_shift",
+         lambda: ([RNG.randint(0, 8, (5,)).astype(np.int32),
+                   RNG.randint(0, 3, (5,)).astype(np.int32)], {}),
+         np.left_shift, bf16=False),
+    Spec("bitwise_right_shift",
+         lambda: ([RNG.randint(0, 32, (5,)).astype(np.int32),
+                   RNG.randint(0, 3, (5,)).astype(np.int32)], {}),
+         np.right_shift, bf16=False),
+    Spec("equal_all", fmat2(3, 4),
+         lambda x, y: np.array_equal(x, y), bf16=False),
+    # ---- manipulation ----------------------------------------------------
+    Spec("reshape", with_kw(fmat(3, 4), shape=[4, 3]),
+         lambda x, shape: x.reshape(shape), grad=(0,)),
+    Spec("transpose", with_kw(fmat(2, 3, 4), perm=[2, 0, 1]),
+         lambda x, perm: np.transpose(x, perm), grad=(0,)),
+    Spec("flatten", lambda: ([RNG.rand(2, 3, 4).astype(np.float32)],
+                             {"start_axis": 1}),
+         lambda x, start_axis: x.reshape(2, 12), grad=(0,)),
+    Spec("squeeze", with_kw(fmat(2, 1, 4), axis=1),
+         lambda x, axis: np.squeeze(x, axis), grad=(0,)),
+    Spec("unsqueeze", with_kw(fmat(2, 4), axis=1),
+         lambda x, axis: np.expand_dims(x, axis), grad=(0,)),
+    Spec("concat", lambda: ([[RNG.rand(2, 3).astype(np.float32),
+                              RNG.rand(2, 3).astype(np.float32)]],
+                            {"axis": 1}),
+         lambda xs, axis: np.concatenate(xs, axis),
+         fn=lambda xs, axis: paddle.concat([T(x) for x in xs], axis=axis),
+         bf16=False),
+    Spec("stack", lambda: ([[RNG.rand(2, 3).astype(np.float32),
+                             RNG.rand(2, 3).astype(np.float32)]], {"axis": 0}),
+         lambda xs, axis: np.stack(xs, axis),
+         fn=lambda xs, axis: paddle.stack([T(x) for x in xs], axis=axis),
+         bf16=False),
+    Spec("split", lambda: ([RNG.rand(2, 6).astype(np.float32)],
+                           {"num_or_sections": 3, "axis": 1}),
+         lambda x, num_or_sections, axis:
+         tuple(np.split(x, num_or_sections, axis))),
+    Spec("chunk", lambda: ([RNG.rand(2, 6).astype(np.float32)],
+                           {"chunks": 2, "axis": 1}),
+         lambda x, chunks, axis: tuple(np.split(x, chunks, axis))),
+    Spec("unstack", with_kw(fmat(3, 4), axis=0),
+         lambda x, axis: tuple(x[i] for i in range(x.shape[axis]))),
+    Spec("unbind", with_kw(fmat(3, 4), axis=0),
+         lambda x, axis: tuple(x[i] for i in range(x.shape[axis]))),
+    Spec("flip", with_kw(fmat(3, 4), axis=[0]),
+         lambda x, axis: np.flip(x, axis), grad=(0,)),
+    Spec("roll", with_kw(fmat(3, 4), shifts=1, axis=1),
+         lambda x, shifts, axis: np.roll(x, shifts, axis), grad=(0,)),
+    Spec("rot90", fmat(3, 4), lambda x: np.rot90(x), grad=(0,)),
+    Spec("tile", with_kw(fmat(2, 3), repeat_times=[2, 1]),
+         lambda x, repeat_times: np.tile(x, repeat_times), grad=(0,)),
+    Spec("expand", with_kw(fmat(1, 4), shape=[3, 4]),
+         lambda x, shape: np.broadcast_to(x, shape), grad=(0,)),
+    Spec("broadcast_to", with_kw(fmat(1, 4), shape=[3, 4]),
+         lambda x, shape: np.broadcast_to(x, shape), grad=(0,)),
+    Spec("expand_as", lambda: ([RNG.rand(1, 4).astype(np.float32),
+                                RNG.rand(3, 4).astype(np.float32)], {}),
+         lambda x, y: np.broadcast_to(x, y.shape)),
+    Spec("tril", fmat(4, 4), np.tril, grad=(0,)),
+    Spec("triu", fmat(4, 4), np.triu, grad=(0,)),
+    Spec("diag", fmat(4), np.diag),
+    Spec("diagflat", fmat(4), np.diagflat),
+    Spec("diag_embed", fmat(2, 3),
+         lambda x: np.stack([np.diag(r) for r in x])),
+    Spec("trace", fmat(4, 4), np.trace, grad=(0,)),
+    Spec("moveaxis", lambda: ([RNG.rand(2, 3, 4).astype(np.float32)],
+                              {"source": 0, "destination": 2}),
+         lambda x, source, destination:
+         np.moveaxis(x, source, destination), grad=(0,)),
+    Spec("swapaxes", lambda: ([RNG.rand(2, 3, 4).astype(np.float32)],
+                              {"axis0": 0, "axis1": 2}),
+         lambda x, axis0, axis1: np.swapaxes(x, axis0, axis1), grad=(0,)),
+    Spec("t", fmat(3, 4), lambda x: x.T, grad=(0,)),
+    Spec("matrix_transpose", fmat(2, 3, 4),
+         lambda x: np.swapaxes(x, -1, -2), grad=(0,)),
+    Spec("repeat_interleave", lambda: ([RNG.rand(3, 2).astype(np.float32)],
+                                       {"repeats": 2, "axis": 0}),
+         lambda x, repeats, axis: np.repeat(x, repeats, axis), grad=(0,)),
+    Spec("gather", lambda: ([RNG.rand(5, 3).astype(np.float32),
+                             np.array([0, 2, 4])], {}),
+         lambda x, idx: x[idx], grad=(0,)),
+    Spec("gather_nd", lambda: ([RNG.rand(3, 4).astype(np.float32),
+                                np.array([[0, 1], [2, 3]])], {}),
+         lambda x, idx: x[idx[:, 0], idx[:, 1]], grad=(0,)),
+    Spec("index_select", lambda: ([RNG.rand(5, 3).astype(np.float32),
+                                   np.array([0, 2])], {"axis": 0}),
+         lambda x, idx, axis: np.take(x, idx, axis), grad=(0,)),
+    Spec("index_sample", lambda: ([RNG.rand(3, 5).astype(np.float32),
+                                   np.array([[0, 1], [2, 3], [4, 0]])], {}),
+         lambda x, idx: np.take_along_axis(x, idx, 1), grad=(0,)),
+    Spec("take_along_axis", lambda: ([RNG.rand(3, 5).astype(np.float32),
+                                      np.array([[0], [2], [4]])], {"axis": 1}),
+         lambda x, idx, axis: np.take_along_axis(x, idx, axis), grad=(0,)),
+    Spec("scatter", lambda: ([RNG.rand(5, 3).astype(np.float32),
+                              np.array([1, 3]),
+                              RNG.rand(2, 3).astype(np.float32)], {}),
+         lambda x, idx, upd: _np_scatter(x, idx, upd), grad=(0, 2)),
+    Spec("masked_select", lambda: ([np.arange(12, dtype=np.float32)
+                                    .reshape(3, 4),
+                                    np.arange(12).reshape(3, 4) % 2 == 0], {}),
+         lambda x, m: x[m]),
+    Spec("masked_fill", lambda: ([RNG.rand(3, 4).astype(np.float32),
+                                  RNG.rand(3, 4) > 0.5, np.float32(-9.0)], {}),
+         lambda x, m, v: np.where(m, v, x), grad=(0,)),
+    Spec("slice", lambda: ([RNG.rand(4, 5).astype(np.float32)],
+                           {"axes": [0, 1], "starts": [1, 0],
+                            "ends": [3, 4]}),
+         lambda x, axes, starts, ends: x[1:3, 0:4], grad=(0,)),
+    Spec("strided_slice", lambda: ([RNG.rand(6, 6).astype(np.float32)],
+                                   {"axes": [0], "starts": [0], "ends": [6],
+                                    "strides": [2]}),
+         lambda x, axes, starts, ends, strides: x[::2], grad=(0,)),
+    Spec("crop", lambda: ([RNG.rand(4, 5).astype(np.float32)],
+                          {"shape": [2, 3], "offsets": [1, 1]}),
+         lambda x, shape, offsets: x[1:3, 1:4], grad=(0,)),
+    Spec("unfold", lambda: ([RNG.rand(1, 1, 4, 4).astype(np.float32)],
+                            {"kernel_size": 2, "strides": 2}),
+         lambda x, kernel_size, strides: _np_im2col(x, 2, 2), grad=(0,)),
+    Spec("bincount", lambda: ([np.array([0, 1, 1, 3, 2, 1])], {}),
+         np.bincount, bf16=False),
+    Spec("histogram", lambda: ([RNG.rand(20).astype(np.float32)],
+                               {"bins": 5, "min": 0.0, "max": 1.0}),
+         lambda x, bins, min, max:
+         np.histogram(x, bins=bins, range=(min, max))[0], bf16=False),
+    Spec("one_hot", lambda: ([np.array([0, 2, 1])], {"num_classes": 4}),
+         lambda x, num_classes: np.eye(num_classes)[x], bf16=False),
+    Spec("multiplex", lambda: ([[RNG.rand(3, 4).astype(np.float32),
+                                 RNG.rand(3, 4).astype(np.float32)],
+                                np.array([0, 1, 0])], {}),
+         lambda xs, idx: np.stack([xs[idx[i]][i] for i in range(len(idx))]),
+         fn=lambda xs, idx: paddle.multiplex([T(x) for x in xs], T(idx)),
+         bf16=False),
+    # ---- search / sort ---------------------------------------------------
+    Spec("argmax", with_kw(fmat(3, 5), axis=1),
+         lambda x, axis: np.argmax(x, axis), bf16=False),
+    Spec("argmin", with_kw(fmat(3, 5), axis=1),
+         lambda x, axis: np.argmin(x, axis), bf16=False),
+    Spec("argsort", with_kw(fmat(3, 5), axis=1),
+         lambda x, axis: np.argsort(x, axis), bf16=False),
+    Spec("sort", with_kw(fmat(3, 5), axis=1),
+         lambda x, axis: np.sort(x, axis), grad=(0,)),
+    Spec("topk", with_kw(fmat(3, 6), k=2, axis=1),
+         lambda x, k, axis: (np.sort(x, axis)[:, :-k - 1:-1], None),
+         grad=(0,)),
+    Spec("kthvalue", with_kw(fmat(7), k=3),
+         lambda x, k: (np.sort(x)[k - 1], None)),
+    Spec("mode", lambda: ([np.array([[1.0, 1, 2], [3, 3, 4]],
+                                    np.float32)], {}),
+         lambda x: (np.array([1.0, 3.0]), None)),
+    Spec("nonzero", lambda: ([np.array([[0.0, 1], [2, 0]], np.float32)], {}),
+         lambda x: np.stack(np.nonzero(x), axis=1), bf16=False),
+    Spec("where", lambda: ([RNG.rand(3, 4) > 0.5,
+                            RNG.rand(3, 4).astype(np.float32),
+                            RNG.rand(3, 4).astype(np.float32)], {}),
+         np.where, grad=(1, 2)),
+    Spec("searchsorted", lambda: ([np.array([1.0, 3, 5, 7], np.float32),
+                                   np.array([0.5, 4.0, 8.0], np.float32)],
+                                  {}),
+         lambda a, v: np.searchsorted(a, v), bf16=False),
+    Spec("bucketize", lambda: ([np.array([0.5, 4.0, 8.0], np.float32),
+                                np.array([1.0, 3, 5, 7], np.float32)], {}),
+         lambda v, edges: np.searchsorted(edges, v), bf16=False),
+    Spec("unique", lambda: ([np.array([3.0, 1, 2, 1, 3], np.float32)], {}),
+         lambda x: np.unique(x), bf16=False),
+    Spec("unique_consecutive", lambda: ([np.array([1.0, 1, 2, 2, 3, 1],
+                                                  np.float32)], {}),
+         lambda x: np.array([1.0, 2, 3, 1]), bf16=False),
+    Spec("index_add", lambda: ([RNG.rand(5, 3).astype(np.float32),
+                                np.array([0, 2]),
+                                RNG.rand(2, 3).astype(np.float32)],
+                               {"axis": 0}),
+         lambda x, idx, v, axis: _np_index_add(x, idx, v),
+         fn=lambda x, idx, v, axis: paddle.index_add(x, idx, axis, v),
+         grad=(0, 2)),
+    # ---- linalg ----------------------------------------------------------
+    Spec("det", lambda: ([_well_conditioned(4)], {}), np.linalg.det,
+         bf16=False, rtol=1e-3),
+    Spec("slogdet", lambda: ([_spd(4)], {}),
+         lambda x: tuple(np.linalg.slogdet(x)), bf16=False, rtol=1e-3),
+    Spec("inverse", lambda: ([_spd(4)], {}), np.linalg.inv, bf16=False,
+         rtol=1e-3, atol=1e-4, grad=(0,)),
+    Spec("cholesky", lambda: ([_spd(4)], {}), np.linalg.cholesky,
+         bf16=False, rtol=1e-3, atol=1e-4, grad=(0,)),
+    Spec("solve", lambda: ([_spd(4), RNG.rand(4, 2).astype(np.float32)], {}),
+         np.linalg.solve, bf16=False, rtol=1e-3, atol=1e-4, grad=(0, 1)),
+    Spec("cholesky_solve", lambda: ([RNG.rand(4, 2).astype(np.float32),
+                                     np.linalg.cholesky(_spd(4))
+                                     .astype(np.float32)], {}),
+         lambda b, L: scipy.linalg.cho_solve((L, True), b), bf16=False,
+         rtol=1e-3, atol=1e-4),
+    Spec("triangular_solve",
+         lambda: ([np.tril(RNG.rand(4, 4) + np.eye(4) * 3)
+                   .astype(np.float32),
+                   RNG.rand(4, 2).astype(np.float32)],
+                  {"upper": False}),
+         lambda a, b, upper: scipy.linalg.solve_triangular(a, b, lower=True),
+         bf16=False, rtol=1e-3, atol=1e-4),
+    Spec("lstsq", lambda: ([RNG.rand(5, 3).astype(np.float32),
+                            RNG.rand(5, 2).astype(np.float32)], {}),
+         lambda a, b: (np.linalg.lstsq(a, b, rcond=None)[0], None),
+         bf16=False, rtol=1e-2, atol=1e-3),
+    Spec("matrix_power", with_kw(lambda: ([_well_conditioned(3)], {}), n=3),
+         lambda x, n: np.linalg.matrix_power(x, n), bf16=False, rtol=1e-3,
+         atol=1e-4),
+    Spec("matrix_rank", lambda: ([_spd(4)], {}),
+         lambda x: np.linalg.matrix_rank(x), bf16=False),
+    Spec("pinv", lambda: ([RNG.rand(4, 3).astype(np.float32)], {}),
+         np.linalg.pinv, bf16=False, rtol=1e-2, atol=1e-3),
+    Spec("eigvalsh", lambda: ([_spd(4)], {}),
+         np.linalg.eigvalsh, bf16=False, rtol=1e-3, atol=1e-4),
+    # ---- int / misc ------------------------------------------------------
+    Spec("cast", with_kw(fmat(3, 4), dtype="int32"),
+         lambda x, dtype: x.astype(np.int32), bf16=False),
+    Spec("numel", fmat(3, 4), lambda x: np.int64(x.size), bf16=False),
+    Spec("shard_index", lambda: ([np.array([1, 5, 9])],
+                                 {"index_num": 12, "nshards": 3,
+                                  "shard_id": 0}),
+         lambda x, index_num, nshards, shard_id:
+         np.array([1, -1, -1]), bf16=False),
+    Spec("increment", fmat(1), lambda x: x + 1, bf16=False),
+    Spec("clone", fmat(3, 4), lambda x: x, grad=(0,)),
+    Spec("assign", fmat(3, 4), lambda x: x),
+]
+
+
+def _np_im2col(x, k, s):
+    n, c, h, w = x.shape
+    cols = []
+    for i in range(0, h - k + 1, s):
+        for j in range(0, w - k + 1, s):
+            cols.append(x[:, :, i:i + k, j:j + k].reshape(n, -1))
+    return np.stack(cols, axis=2)
+
+
+def _np_scatter(x, idx, upd):
+    out = x.copy()
+    out[idx] = upd
+    return out
+
+
+def _np_index_add(x, idx, v):
+    out = x.copy()
+    np.add.at(out, idx, v)
+    return out
+
+
+def _spd(n):
+    a = RNG.rand(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def _well_conditioned(n):
+    a = RNG.rand(n, n)
+    return (a + n * np.eye(n)).astype(np.float32)
+
+
+_BY_NAME = {s.name: s for s in SPECS}
+GRAD_SPECS = [s for s in SPECS if s.grad]
+BF16_SPECS = [s for s in SPECS if s.bf16]
+
+
+def test_coverage_count():
+    """The CI-visible op-coverage counter (VERDICT.md round-1 item 6)."""
+    n = len(SPECS)
+    print(f"\nOP-COVERAGE: {n} ops, {len(GRAD_SPECS)} grad-checked, "
+          f"{len(BF16_SPECS)} bf16-checked")
+    assert n >= 120
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: s.name)
+def test_forward_parity_f32(spec):
+    _check_parity(spec, np.float32)
+
+
+@pytest.mark.parametrize("spec", BF16_SPECS, ids=lambda s: s.name)
+def test_forward_parity_bf16(spec):
+    _check_parity(spec, BF16)
+
+
+@pytest.mark.parametrize("spec", GRAD_SPECS, ids=lambda s: s.name)
+def test_grad(spec):
+    _check_grad(spec)
+
+
+@pytest.mark.parametrize("spec", [s for s in GRAD_SPECS if s.bf16],
+                         ids=lambda s: s.name)
+def test_grad_bf16_vs_f32(spec):
+    """bf16 analytic grads track the f32 analytic grads (the reference's
+    bf16 check_grad variant with white-list tolerances)."""
+    np_inputs, kwargs = spec.make()
+    grads = {}
+    for dtype in (np.float32, BF16):
+        cast = [a.astype(dtype) if isinstance(a, np.ndarray) and
+                a.dtype == np.float32 else a for a in np_inputs]
+        tensors = []
+        for i, a in enumerate(cast):
+            if isinstance(a, np.ndarray):
+                tensors.append(T(a, stop_gradient=i not in spec.grad))
+            else:
+                tensors.append(a)
+        out = spec.op()(*tensors, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        loss = None
+        for o in outs:
+            if hasattr(o, "numpy") and o.dtype in (np.float32, BF16):
+                term = o.astype("float32").sum()
+                loss = term if loss is None else loss + term
+        if loss is None:
+            pytest.skip("no float output under bf16")
+        loss.backward()
+        grads[np.dtype(dtype).name if dtype == np.float32 else "bf16"] = [
+            tensors[i].grad.numpy().astype(np.float64)
+            if tensors[i].grad is not None else None for i in spec.grad]
+    for g32, g16 in zip(grads["float32"], grads["bf16"]):
+        if g32 is None or g16 is None:
+            continue
+        np.testing.assert_allclose(g16, g32, rtol=6e-2, atol=6e-2,
+                                   err_msg=f"{spec.name} bf16 grad")
