@@ -15,9 +15,9 @@ import jax as _jax
 # f32 via our own dtype conversion in core.tensor._to_array.
 _jax.config.update("jax_enable_x64", True)
 
-from .core import (Generator, Parameter, Tensor, enable_grad, grad,
-                   is_grad_enabled, no_grad, seed, set_grad_enabled,
-                   to_tensor)
+from .core import (Generator, Parameter, Tensor, enable_grad,
+                   get_rng_state, grad, is_grad_enabled, no_grad, seed,
+                   set_grad_enabled, set_rng_state, to_tensor)
 from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
                          float32, float64, get_default_dtype, int8, int16,
                          int32, int64, set_default_dtype, uint8)

@@ -1,2 +1,3 @@
 """paddle_tpu.incubate (reference surface: python/paddle/incubate/)."""
+from . import checkpoint  # noqa: F401
 from . import nn  # noqa: F401

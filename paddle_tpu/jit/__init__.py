@@ -27,7 +27,8 @@ from ..core.grad_mode import no_grad
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static"]
+__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
+           "save", "load", "TranslatedLayer"]
 
 
 def _unwrap(x):
@@ -83,6 +84,7 @@ class StaticFunction:
     def __init__(self, target, input_spec=None, build_strategy=None,
                  backend=None):
         self._target = target
+        self._input_spec = input_spec
         self._is_layer = isinstance(target, Layer)
         if self._is_layer:
             self._jitted = jax.jit(self._layer_core)
@@ -137,6 +139,47 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 def not_to_static(fn):
     fn._not_to_static = True
     return fn
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save equivalent (reference: jit/api.py save → dy2static →
+    save_inference_model).  Exports a standalone executable artifact via
+    jax.export; loadable with :func:`load` WITHOUT the original class."""
+    from ..static import save_inference_model
+
+    target = layer._target if isinstance(layer, StaticFunction) else layer
+    if not isinstance(target, Layer):
+        raise TypeError("jit.save expects a Layer or to_static(Layer); "
+                        "got %r" % (type(layer).__name__,))
+    if input_spec is None:
+        input_spec = getattr(layer, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec=[InputSpec(...), ...] "
+                         "(shapes are static under XLA)")
+    return save_inference_model(path, model=target, input_spec=input_spec,
+                                **config)
+
+
+class TranslatedLayer(Layer):
+    """The loaded-artifact Layer (reference: fluid/dygraph/io.py
+    TranslatedLayer): callable like a Layer, runs the deserialized exported
+    program; no original class needed."""
+
+    def __init__(self, predictor):
+        super().__init__()
+        self._predictor = predictor
+
+    def forward(self, *args):
+        return self._predictor(*args)
+
+
+def load(path, **config):
+    """paddle.jit.load equivalent: returns a callable TranslatedLayer running
+    the serialized StableHLO module."""
+    from ..static import load_inference_model
+
+    predictor = load_inference_model(path, **config)
+    return TranslatedLayer(predictor)
 
 
 class TrainStep:
@@ -306,3 +349,33 @@ class TrainStep:
         """Write the trained arrays back into the eager model."""
         self.model.load_functional_state({**self.params, **self.buffers})
         self._dirty = False
+
+    # -- checkpoint contract (incubate.checkpoint) -------------------------
+    def state_dict(self):
+        """Everything needed to resume: params, buffers, optimizer slots,
+        and the LR-scheduler/optimizer bookkeeping."""
+        opt_extra = {}
+        lr = self.optimizer._learning_rate
+        if hasattr(lr, "state_dict"):
+            opt_extra["lr_scheduler"] = lr.state_dict()
+        return {"params": self.params, "buffers": self.buffers,
+                "opt_state": self.opt_state, "opt_extra": opt_extra}
+
+    def set_state_dict(self, state):
+        """Restore from :meth:`state_dict` output.  Arrays are re-placed on
+        their current shardings (ZeRO layouts survive a restore)."""
+        def place_like(new, old):
+            if hasattr(old, "sharding") and hasattr(new, "shape"):
+                return jax.device_put(jnp.asarray(new), old.sharding)
+            return new
+        self.params = {k: place_like(v, self.params.get(k))
+                       for k, v in state["params"].items()}
+        self.buffers = {k: place_like(v, self.buffers.get(k))
+                        for k, v in state["buffers"].items()}
+        self.opt_state = jax.tree_util.tree_map(
+            place_like, state["opt_state"], self.opt_state)
+        lr = self.optimizer._learning_rate
+        sched = state.get("opt_extra", {}).get("lr_scheduler")
+        if sched is not None and hasattr(lr, "set_state_dict"):
+            lr.set_state_dict(sched)
+        self._dirty = True

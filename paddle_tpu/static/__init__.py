@@ -42,16 +42,25 @@ class InputSpec:
         return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, model=None, input_spec=None, **kwargs):
-    """Export a compiled inference artifact.
+def save_inference_model(path_prefix, feed_vars=None, fetch_vars=None,
+                         executor=None, program=None, model=None,
+                         input_spec=None, platforms=None, **kwargs):
+    """Export a standalone, executable inference artifact.
 
-    TPU-native form: StableHLO text of the jitted forward + a weights pickle.
-    ``model`` (a Layer) + ``input_spec`` is the primary TPU path; the
-    feed/fetch-vars signature is accepted for API parity.
+    TPU-native form of the reference's __model__ ProgramDesc + params
+    (static/io.py:433): a ``jax.export`` serialized StableHLO module
+    (versioned, self-contained — the analogue of the versioned ProgramDesc,
+    framework.proto:23) plus a weights pickle.  The artifact is executable
+    WITHOUT the original Layer class (analysis_predictor.h:90 load-and-run
+    contract).  StableHLO text is also written for inspection.
+
+    ``platforms`` optionally lists lowering platforms (e.g. ("cpu", "tpu"))
+    so one artifact serves both; default = current backend.
     """
     if model is None:
         raise ValueError("TPU build: pass model=<Layer> and input_spec=[...]")
+    from jax import export as jexport
+
     from ..jit import functional_call
 
     state = model.functional_state()
@@ -63,36 +72,58 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         out, _ = functional_call(model, state, *args)
         return out
 
-    lowered = jax.jit(fwd).lower(state, *specs)
+    jitted = jax.jit(fwd)
+    exported = jexport.export(jitted, platforms=platforms)(state, *specs)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
     with open(path_prefix + ".stablehlo.mlir", "w") as f:
-        f.write(lowered.as_text(dialect="stablehlo"))
+        f.write(exported.mlir_module())
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump({k: np.asarray(v) for k, v in state.items()}, f)
-    meta = {"input_specs": [(list(s.shape), str(s.dtype)) for s in specs]}
+    meta = {"input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+            "format_version": 1}
     with open(path_prefix + ".pdmodel.meta", "wb") as f:
         pickle.dump(meta, f)
     return path_prefix
 
 
 class _Predictor:
+    """Executable predictor over a deserialized exported module (the
+    AnalysisPredictor analogue, analysis_predictor.h:90/:132)."""
+
     def __init__(self, fn, state):
         self._fn = fn
         self._state = state
 
-    def run(self, feeds):
-        arrs = [f._array if isinstance(f, Tensor) else jnp.asarray(f)
+    @staticmethod
+    def _unwrap_feeds(feeds):
+        return [f._array if isinstance(f, Tensor) else jnp.asarray(f)
                 for f in feeds]
-        out = self._fn(self._state, *arrs)
+
+    def run(self, feeds):
+        out = self._fn(self._state, *self._unwrap_feeds(feeds))
         return [Tensor(o) for o in jax.tree_util.tree_leaves(out)]
 
     def __call__(self, *feeds):
-        return self.run(list(feeds))
+        return _wrap_out(self._fn(self._state, *self._unwrap_feeds(feeds)))
+
+
+def _wrap_out(out):
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_out(o) for o in out)
+    return Tensor(out) if hasattr(out, "dtype") else out
 
 
 def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
-    """Load the exported artifact. If the original Layer class is supplied via
-    ``model``, rebuilds an executable predictor (weights + jitted forward)."""
+    """Load the exported artifact into an executable predictor.
+
+    The serialized module is deserialized via ``jax.export`` and called
+    directly — the original Layer class is NOT required (the reference's
+    AnalysisPredictor loads and runs a ProgramDesc the same way,
+    analysis_predictor.h:90).  Passing ``model`` re-traces through the live
+    Layer instead (useful to re-lower for a new platform).
+    """
     with open(path_prefix + ".pdiparams", "rb") as f:
         state = pickle.load(f)
     state = {k: jnp.asarray(v) for k, v in state.items()}
@@ -106,10 +137,10 @@ def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
             return out
 
         return _Predictor(fwd, state)
-    # without the Layer, return raw artifacts (StableHLO text + weights)
-    with open(path_prefix + ".stablehlo.mlir") as f:
-        hlo_text = f.read()
-    return hlo_text, state
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    return _Predictor(jax.jit(exported.call), state)
 
 
 @contextlib.contextmanager
@@ -170,6 +201,92 @@ class Executor:
 
 # namespace parity: paddle.static.nn
 class nn:
+    """Static-graph layer namespace.  The control-flow entries are the
+    TPU-native answer to the reference's conditional_block_op.cc/while_op.cc:
+    under trace they lower to lax.cond/lax.while_loop (compiled, no Python
+    re-execution); eagerly they just run."""
+
     @staticmethod
     def fc(x, size, **kw):
         raise NotImplementedError("use paddle_tpu.nn.Linear")
+
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        import jax.lax as lax
+
+        def _unwrap(v):
+            return v._array if isinstance(v, Tensor) else v
+
+        p = _unwrap(pred)
+        t = (lambda _: _unwrap_all(true_fn())) if true_fn else (lambda _: None)
+        f = (lambda _: _unwrap_all(false_fn())) if false_fn else (lambda _: None)
+        out = lax.cond(jnp.asarray(p).astype(bool).reshape(()), t, f,
+                       operand=None)
+        return _wrap_out(out)
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        import jax.lax as lax
+        init = tuple(_unwrap_all(v) for v in loop_vars)
+
+        def c(vs):
+            r = cond(*_wrap_out(list(vs)))
+            r = r._array if isinstance(r, Tensor) else r
+            return jnp.asarray(r).astype(bool).reshape(())
+
+        def b(vs):
+            r = body(*_wrap_out(list(vs)))
+            if not isinstance(r, (list, tuple)):
+                r = (r,)
+            return tuple(_unwrap_all(v) for v in r)
+
+        out = lax.while_loop(c, b, init)
+        return list(_wrap_out(list(out)))
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        import jax.lax as lax
+        preds = [p._array if isinstance(p, Tensor) else p
+                 for p, _ in pred_fn_pairs]
+        fns = [fn for _, fn in pred_fn_pairs]
+        if default is not None:
+            fns = fns + [default]
+        # index of first true pred (or len(preds) for default)
+        stack = jnp.stack([jnp.asarray(p).astype(bool).reshape(())
+                           for p in preds])
+        idx = jnp.where(stack.any(), jnp.argmax(stack), len(preds))
+        idx = jnp.minimum(idx, len(fns) - 1)
+        out = lax.switch(idx, [lambda _, f=f: _unwrap_all(f()) for f in fns],
+                         None)
+        return _wrap_out(out)
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        import jax.lax as lax
+        if isinstance(branch_fns, dict):
+            items = sorted(branch_fns.items())
+        else:
+            items = list(enumerate(branch_fns)) \
+                if not isinstance(branch_fns[0], (list, tuple)) \
+                else [tuple(p) for p in branch_fns]
+            items.sort(key=lambda kv: kv[0])
+        keys = [k for k, _ in items]
+        fns = [fn for _, fn in items]
+        if default is not None:
+            fns = fns + [default]
+        bi = branch_index._array if isinstance(branch_index, Tensor) \
+            else branch_index
+        bi = jnp.asarray(bi).reshape(()).astype(jnp.int32)
+        # map branch_index -> position in keys (default otherwise)
+        pos = jnp.full((), len(fns) - 1, jnp.int32)
+        for i, k in enumerate(keys):
+            pos = jnp.where(bi == k, jnp.int32(i), pos)
+        out = lax.switch(pos, [lambda _, f=f: _unwrap_all(f()) for f in fns],
+                         None)
+        return _wrap_out(out)
+
+
+def _unwrap_all(tree):
+    return jax.tree_util.tree_map(
+        lambda l: l._array if isinstance(l, Tensor) else l, tree,
+        is_leaf=lambda l: isinstance(l, Tensor))

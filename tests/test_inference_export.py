@@ -1,0 +1,127 @@
+"""Executable inference artifact tests.
+
+Contract under test (reference: paddle/fluid/inference/api/analysis_predictor.h:90
+load-and-run without the model-building code; python/paddle/static/io.py:433
+save_inference_model): the exported artifact must run in a FRESH process with
+only paddle_tpu installed — no access to the original Layer class.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec, load_inference_model, save_inference_model
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _export(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = paddle.randn([3, 8])
+    want = net(x).numpy()
+    prefix = os.path.join(str(tmp_path), "model")
+    save_inference_model(prefix, model=net,
+                         input_spec=[InputSpec([3, 8], "float32")])
+    return prefix, x.numpy(), want
+
+
+def test_save_then_load_without_class(tmp_path):
+    prefix, x, want = _export(tmp_path)
+    # a module + params + meta + stablehlo text all exist
+    for suffix in (".pdmodel", ".pdiparams", ".pdmodel.meta",
+                   ".stablehlo.mlir"):
+        assert os.path.exists(prefix + suffix), suffix
+    predictor = load_inference_model(prefix)  # NOTE: no model class passed
+    got = predictor(x)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_load_in_fresh_process(tmp_path):
+    prefix, x, want = _export(tmp_path)
+    np.save(os.path.join(str(tmp_path), "x.npy"), x)
+    np.save(os.path.join(str(tmp_path), "want.npy"), want)
+    script = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import numpy as np
+        from paddle_tpu.static import load_inference_model
+        prefix = sys.argv[1]
+        x = np.load(os.path.join(os.path.dirname(prefix), "x.npy"))
+        want = np.load(os.path.join(os.path.dirname(prefix), "want.npy"))
+        predictor = load_inference_model(prefix)
+        got = predictor(x)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+        print("FRESH_PROCESS_OK")
+    """)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", script, prefix],
+                       capture_output=True, text=True, timeout=300,
+                       cwd="/root/repo", env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FRESH_PROCESS_OK" in r.stdout
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = paddle.randn([2, 8])
+    want = net(x).numpy()
+    prefix = os.path.join(str(tmp_path), "jit_model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(prefix)
+    got = loaded(x)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_jit_save_needs_spec(tmp_path):
+    with pytest.raises(ValueError):
+        paddle.jit.save(SmallNet(), os.path.join(str(tmp_path), "m"))
+
+
+def test_static_nn_cond():
+    from paddle_tpu.static import nn as snn
+    a = paddle.to_tensor(2.0)
+    out = snn.cond(a > 1.0, lambda: a * 2, lambda: a - 1)
+    assert float(out) == 4.0
+    out = snn.cond(a > 3.0, lambda: a * 2, lambda: a - 1)
+    assert float(out) == 1.0
+
+
+def test_static_nn_while_loop():
+    from paddle_tpu.static import nn as snn
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0)
+    i2, s2 = snn.while_loop(lambda i, s: i < 5,
+                            lambda i, s: (i + 1, s + i), [i, s])
+    assert int(i2) == 5 and int(s2) == 10
+
+
+def test_static_nn_switch_case():
+    from paddle_tpu.static import nn as snn
+    idx = paddle.to_tensor(1)
+    out = snn.switch_case(idx, {0: lambda: paddle.to_tensor(10.0),
+                                1: lambda: paddle.to_tensor(20.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+    assert float(out) == 20.0
+    out = snn.switch_case(paddle.to_tensor(7),
+                          {0: lambda: paddle.to_tensor(10.0),
+                           1: lambda: paddle.to_tensor(20.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+    assert float(out) == -1.0
