@@ -65,8 +65,12 @@ def _spawn_entry(func, args, env):
     func(*args)
 
 
-class launch:
-    """CLI launcher namespace (reference: python/paddle/distributed/launch).
-    TPU launch is typically one process per host started by the cluster
-    scheduler; `python -m paddle_tpu.distributed.launch_main` wraps that."""
-    pass
+def __getattr__(name):
+    # lazy: `paddle.distributed.launch` is the launcher module (reference:
+    # python/paddle/distributed/launch).  Imported on attribute access so
+    # `python -m paddle_tpu.distributed.launch_main` doesn't trigger the
+    # runpy double-import warning.
+    if name == "launch":
+        from . import launch_main
+        return launch_main
+    raise AttributeError(name)

@@ -1,21 +1,85 @@
 """Hybrid-parallel optimizer wrapper (reference:
 fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py).
 
-On the GSPMD path gradient synchronisation is already inserted by XLA, so
-this wrapper's remaining responsibilities are mp-aware grad clipping and
-API parity (step/clear_grad passthrough).
+Its one non-trivial responsibility in the reference is **mp-aware global-norm
+gradient clipping**: under tensor parallelism each rank holds only a slice of
+the distributed parameters, so the global grad norm is
+
+    sqrt( psum_over_mp(sum_sq(distributed grads)) + sum_sq(replicated grads) )
+
+— replicated params counted once, sharded params summed across the mp group
+(reference `_obtain_optimizer_parameters_list` + HybridParallelClipGrad).
+
+TPU-native placement of that logic: on the GSPMD path parameter arrays are
+*global* logical arrays (XLA inserts the collectives), so the plain global
+norm is already correct; inside a ``shard_map`` region, however, a
+distributed param's leaf IS the local shard, and the psum is required.
+``_HybridClipGradByGlobalNorm`` handles both: it psums the distributed
+contribution when the mp axis is bound in the current trace and falls back
+to the plain sum otherwise.
 """
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+
+class _HybridClipGradByGlobalNorm:
+    """Drop-in for nn.ClipGradByGlobalNorm with an mp-aware total norm.
+    Registered as a virtual subclass so Optimizer._clip_tree dispatches to
+    the global-norm branch and calls ``_total_norm``."""
+
+    def __init__(self, clip_norm, mp_axis="mp"):
+        self.clip_norm = clip_norm
+        self.mp_axis = mp_axis
+
+    def _total_norm(self, live, dist_flags):
+        rep_sq = jnp.zeros((), jnp.float32)
+        dist_sq = jnp.zeros((), jnp.float32)
+        have_dist = False
+        for i, g in live:
+            sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if dist_flags is not None and i < len(dist_flags) \
+                    and dist_flags[i]:
+                dist_sq = dist_sq + sq
+                have_dist = True
+            else:
+                rep_sq = rep_sq + sq
+        if have_dist:
+            from ..collective import _in_trace
+            if _in_trace(self.mp_axis):
+                # inside shard_map over the mp axis: local shards → psum
+                dist_sq = jax.lax.psum(dist_sq, self.mp_axis)
+            # else GSPMD path: leaves are global arrays, sum already global
+        return jnp.sqrt(rep_sq + dist_sq)
+
 
 class HybridParallelOptimizer:
+    """reference: hybrid_parallel_optimizer.py — wraps the user optimizer
+    with mp-aware clipping; step/clear_grad/minimize pass through."""
+
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        clip = getattr(optimizer, "_grad_clip", None)
+        mp_degree = 1
+        if hcg is not None:
+            get_mp = getattr(hcg, "get_model_parallel_world_size", None)
+            if get_mp is not None:
+                mp_degree = get_mp()
+        from ...nn import ClipGradByGlobalNorm
+        if clip is not None and isinstance(clip, ClipGradByGlobalNorm) \
+                and mp_degree > 1:
+            optimizer._grad_clip = _make_mp_clip(clip.clip_norm)
 
     def __getattr__(self, item):
-        return getattr(self.__dict__["_inner_opt"], item)
+        inner = self.__dict__.get("_inner_opt")
+        if inner is None:
+            # copy/pickle probe attributes before __init__ runs — must be
+            # AttributeError, not KeyError, for hasattr/copy fallbacks
+            raise AttributeError(item)
+        return getattr(inner, item)
 
     def step(self):
         self._inner_opt.step()
@@ -31,3 +95,16 @@ class HybridParallelOptimizer:
 
     def set_state_dict(self, sd):
         return self._inner_opt.set_state_dict(sd)
+
+
+def _make_mp_clip(clip_norm, mp_axis="mp"):
+    """Instantiate the mp-aware clip as a real subclass of
+    nn.ClipGradByGlobalNorm so existing isinstance dispatch picks it up."""
+    from ...nn import ClipGradByGlobalNorm
+
+    class _Clip(ClipGradByGlobalNorm, _HybridClipGradByGlobalNorm):
+        def __init__(self):
+            ClipGradByGlobalNorm.__init__(self, clip_norm)
+            self.mp_axis = mp_axis
+
+    return _Clip()

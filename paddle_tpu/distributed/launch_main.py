@@ -1,0 +1,201 @@
+"""Distributed launch CLI (reference: python/paddle/distributed/launch/main.py:18
++ launch/controllers/collective.py — spawn per-host workers, wire the cluster
+env, write per-rank logs; elastic restart per fleet/elastic/manager.py:130).
+
+TPU-native shape: one worker process per host is the normal topology (the
+single-controller pjit model fans out across the host's chips), so
+``--nproc_per_node`` defaults to 1; multiple local procs are supported for
+CPU-mesh testing and multi-process simulation.
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch_main \
+        [--nnodes 1] [--node_rank 0] [--nproc_per_node N] \
+        [--master host:port] [--log_dir log] \
+        [--elastic] [--max_restarts 3] \
+        training_script [args...]
+
+Env contract given to every worker (reference names, launch/controllers):
+``PADDLE_TRAINER_ID`` (global rank), ``PADDLE_TRAINERS_NUM`` (world size),
+``PADDLE_MASTER``, ``PADDLE_LOCAL_RANK``, ``PADDLE_CURRENT_ENDPOINT``,
+``PADDLE_TRAINER_ENDPOINTS``; `init_parallel_env` consumes these
+(parallel_base.py).  With ``--elastic``, a worker that dies is restarted (up
+to ``--max_restarts`` times) and is expected to resume from its newest
+checkpoint (incubate.checkpoint auto-resume contract).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["main", "Launcher"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.getenv("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.getenv("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.getenv("PADDLE_NPROC_PER_NODE", "1")))
+    p.add_argument("--master", type=str,
+                   default=os.getenv("PADDLE_MASTER", ""))
+    p.add_argument("--ips", type=str,
+                   default=os.getenv("PADDLE_NODE_IPS", ""),
+                   help="comma-separated node hostnames/IPs, one per node "
+                        "(required for --nnodes > 1)")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart dead workers (fleet/elastic semantics)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--poll_interval", type=float, default=0.2)
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Launcher:
+    """Spawns + supervises this node's worker processes."""
+
+    def __init__(self, nnodes=1, node_rank=0, nproc_per_node=1, master="",
+                 ips="", log_dir="log", elastic=False, max_restarts=3,
+                 poll_interval=0.2):
+        self.nnodes = nnodes
+        self.node_rank = node_rank
+        self.nproc = nproc_per_node
+        self.master = master
+        self.ips = [h for h in ips.split(",") if h] if ips else []
+        if nnodes > 1 and len(self.ips) != nnodes:
+            raise ValueError(
+                f"--nnodes {nnodes} needs --ips with exactly {nnodes} "
+                "hostnames (endpoints cannot be 127.0.0.1 across nodes)")
+        self.log_dir = log_dir
+        self.elastic = elastic
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.world_size = nnodes * nproc_per_node
+        self._procs: List[Optional[subprocess.Popen]] = []
+        self._logs: List = []
+        self._restarts = [0] * nproc_per_node
+
+    # -- env wiring ---------------------------------------------------------
+    def _worker_env(self, local_rank: int) -> dict:
+        rank = self.node_rank * self.nproc + local_rank
+        env = dict(os.environ)
+        base_port = int(os.getenv("PADDLE_WORKER_PORT_BASE", "6170"))
+
+        def host_of(r):
+            return self.ips[r // self.nproc] if self.ips else "127.0.0.1"
+
+        endpoints = ",".join(
+            f"{host_of(r)}:{base_port + r % self.nproc}"
+            for r in range(self.world_size))
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.world_size),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_RANK_IN_NODE": str(local_rank),
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{host_of(rank)}:{base_port + local_rank}",
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_RESTART_COUNT": str(self._restarts[local_rank]),
+        })
+        if self.master:
+            env["PADDLE_MASTER"] = self.master
+        return env
+
+    # -- process control ----------------------------------------------------
+    def _start_one(self, local_rank: int, cmd: List[str]):
+        rank = self.node_rank * self.nproc + local_rank
+        os.makedirs(self.log_dir, exist_ok=True)
+        log = open(os.path.join(self.log_dir, f"workerlog.{rank}"), "ab",
+                   buffering=0)
+        proc = subprocess.Popen(cmd, env=self._worker_env(local_rank),
+                                stdout=log, stderr=subprocess.STDOUT)
+        return proc, log
+
+    def run(self, cmd: List[str]) -> int:
+        """Start all local workers and supervise until done.  Returns the
+        job exit code (0 = every worker exited 0)."""
+        self._procs, self._logs = [], []
+        for lr in range(self.nproc):
+            p, log = self._start_one(lr, cmd)
+            self._procs.append(p)
+            self._logs.append(log)
+        try:
+            return self._supervise(cmd)
+        finally:
+            self._kill_all()
+            for log in self._logs:
+                try:
+                    log.close()
+                except Exception:
+                    pass
+
+    def _supervise(self, cmd) -> int:
+        live = set(range(self.nproc))
+        while live:
+            time.sleep(self.poll_interval)
+            for lr in sorted(live):
+                rc = self._procs[lr].poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    live.discard(lr)
+                    continue
+                # worker death (reference: elastic watch → restart)
+                if self.elastic and self._restarts[lr] < self.max_restarts:
+                    self._restarts[lr] += 1
+                    sys.stderr.write(
+                        f"[launch] worker {lr} exited rc={rc}; elastic "
+                        f"restart {self._restarts[lr]}/{self.max_restarts}\n")
+                    p, log = self._start_one(lr, cmd)
+                    self._procs[lr] = p
+                    self._logs.append(log)
+                else:
+                    sys.stderr.write(
+                        f"[launch] worker {lr} exited rc={rc}; aborting job\n")
+                    return rc
+        return 0
+
+    def _kill_all(self):
+        for p in self._procs:
+            if p is not None and p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except Exception:
+                    pass
+        deadline = time.time() + 5
+        for p in self._procs:
+            if p is None:
+                continue
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+
+
+def main(argv=None) -> int:
+    args = _parse(sys.argv[1:] if argv is None else argv)
+    cmd = [sys.executable, args.script] + args.script_args
+    launcher = Launcher(
+        nnodes=args.nnodes, node_rank=args.node_rank,
+        nproc_per_node=args.nproc_per_node, master=args.master,
+        ips=args.ips, log_dir=args.log_dir, elastic=args.elastic,
+        max_restarts=args.max_restarts, poll_interval=args.poll_interval)
+    return launcher.run(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,7 +154,12 @@ class CheckpointManager:
             err, self._err = self._err, None
             raise RuntimeError("previous async checkpoint failed") from err
         payload = _to_host(state)
-        if self.async_save and not wait:
+        # multi-host publication needs device barriers (sync_global_devices);
+        # those must be issued from the main thread in the same order as the
+        # training step's collectives on every host — a barrier on the writer
+        # thread could interleave with training collectives and deadlock the
+        # pod.  So async applies single-host; multi-host saves synchronously.
+        if self.async_save and not wait and self._nhosts == 1:
             self._q.put((step, payload))
         else:
             self._write(step, payload)

@@ -12,10 +12,21 @@ allreduce; SURVEY.md §2.2 row 1).  This wrapper therefore:
 """
 from __future__ import annotations
 
+import jax
+import numpy as np
+
 from .layer.layers import Layer
 
 
 class DataParallel(Layer):
+    """Multi-process eager DP keeps the reference semantics
+    (parallel.py:413): parameters are broadcast from rank 0 at wrap time
+    (sync_params_buffers ≈ parallel.py:369) and ``apply_collective_grads``
+    mean-reduces gradients across processes after ``backward()`` — the
+    EagerReducer's job (reducer.h:87), done with one fused cross-process
+    psum via multihost_utils instead of bucketed NCCL.  Single-process
+    (the normal TPU pjit topology) both are no-ops."""
+
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
@@ -24,6 +35,9 @@ class DataParallel(Layer):
         self.add_sublayer("_layers", layers)
         self.find_unused_parameters = find_unused_parameters
         self.group = group
+        self._nprocs = jax.process_count()
+        if self._nprocs > 1:
+            self.sync_params_buffers()
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -34,11 +48,60 @@ class DataParallel(Layer):
     def set_state_dict(self, state_dict, *a, **k):
         return self._layers.set_state_dict(state_dict, *a, **k)
 
+    def sync_params_buffers(self):
+        """Broadcast rank-0 parameters/buffers to every process
+        (reference: parallel.py:369)."""
+        if self._nprocs <= 1:
+            return
+        from jax.experimental import multihost_utils
+        state = self._layers.state_dict()
+        arrays = {k: np.asarray(t._array) for k, t in state.items()}
+        synced = multihost_utils.broadcast_one_to_all(arrays)
+        for k, t in state.items():
+            t._array = jax.numpy.asarray(synced[k]).astype(t._array.dtype)
+
     def scale_loss(self, loss):
-        # XLA handles gradient averaging via mean-over-batch + psum; no-op
+        # gradient averaging happens in apply_collective_grads (mean), so
+        # the loss itself is not rescaled — same net semantics as the
+        # reference's scale+sum
         return loss
 
     def apply_collective_grads(self):
-        # grads are already globally reduced on the compiled path; on the
-        # eager single-process path there is nothing to reduce
-        pass
+        """Mean-reduce every parameter gradient across processes (the
+        EagerReducer allreduce, reducer.h:87).  Call between backward()
+        and optimizer.step() — no-op single-process.
+
+        Keyed by parameter NAME over the full trainable set, with a
+        has-grad flag per rank: ranks that skipped a conditional branch
+        (find_unused_parameters case) contribute zeros and the sum divides
+        by world size, matching the reference's allreduce-mean — positional
+        keying after filtering would silently pair different parameters
+        across ranks."""
+        if self._nprocs <= 1:
+            return
+        from jax.experimental import multihost_utils
+        named = [(name, p) for name, p in self._layers.named_parameters()
+                 if not p.stop_gradient]
+        if not named:
+            return
+        payload = {}
+        for name, p in named:
+            if p.grad is not None:
+                payload[name] = (np.float32(1.0), np.asarray(p.grad._array))
+            else:
+                payload[name] = (np.float32(0.0),
+                                 np.zeros(tuple(p.shape),
+                                          np.asarray(p._array).dtype))
+        # process_allgather stacks per-process leaves along axis 0
+        gathered = multihost_utils.process_allgather(payload)
+        for name, p in named:
+            counts, grads = gathered[name]
+            if float(np.sum(counts)) == 0:
+                continue  # unused on every rank: leave grad as-is
+            g = np.sum(grads, axis=0) / self._nprocs
+            if p.grad is None:
+                from ..core.tensor import Tensor
+                p.grad = Tensor(jax.numpy.asarray(g))
+            else:
+                p.grad._array = jax.numpy.asarray(g).astype(
+                    p.grad._array.dtype)

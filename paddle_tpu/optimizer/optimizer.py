@@ -153,15 +153,21 @@ class Optimizer:
         slots_out = _unflatten_slots(new_slots, treedef)
         return params_out, {"slots": slots_out, "step": step}
 
-    def _clip_tree(self, p_leaves, g_leaves):
+    def _clip_tree(self, p_leaves, g_leaves, dist_flags=None):
         from ..nn import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)
         clip = self._grad_clip
         if clip is None:
             return g_leaves
         live = [(i, g) for i, g in enumerate(g_leaves) if g is not None]
         if isinstance(clip, ClipGradByGlobalNorm):
-            total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                                 for _, g in live))
+            if hasattr(clip, "_total_norm"):
+                # mp-aware subclass (fleet.HybridParallelOptimizer): norms of
+                # distributed params are psum'd over the model-parallel axis
+                total = clip._total_norm(live, dist_flags)
+            else:
+                total = jnp.sqrt(
+                    sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for _, g in live))
             coef = clip.clip_norm / jnp.maximum(total, clip.clip_norm)
             out = list(g_leaves)
             for i, g in live:
@@ -196,8 +202,12 @@ class Optimizer:
                 if id(p) not in self._accumulators:
                     self._accumulators[id(p)] = self._init_slots(p._array)
 
+            flags = [bool(getattr(p, "is_distributed", False))
+                     for p in params]
+
             def _update(p_arrs, g_arrs, slot_list, lr, step):
-                g_arrs = self._clip_tree(p_arrs, list(g_arrs))
+                g_arrs = self._clip_tree(p_arrs, list(g_arrs),
+                                         dist_flags=flags)
                 new_p, new_s = [], []
                 for p, g, s in zip(p_arrs, g_arrs, slot_list):
                     np_, ns = self._update_leaf(g, p, s, lr, step)
