@@ -1,0 +1,135 @@
+"""paddle.fft — discrete Fourier transforms (reference surface:
+python/paddle/fft.py, backed by phi fft kernels
+paddle/phi/kernels/funcs/fft.h).
+
+TPU-native: jnp.fft lowers to XLA's FFT HLO.  Norm conventions follow the
+reference ("backward" default, "forward", "ortho").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import wrap_op
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "forward", "ortho"):
+        raise ValueError(f"Unexpected norm: {norm!r} (expected 'forward', "
+                         "'backward' or 'ortho')")
+    return norm
+
+
+def _mk1(jfn, name):
+    @wrap_op
+    def op(x, n=None, axis=-1, norm="backward", **kw):
+        return jfn(x, n=n, axis=axis, norm=_norm(norm))
+    op.__name__ = name
+    return op
+
+
+def _mk2(jfn, name):
+    @wrap_op
+    def op(x, s=None, axes=(-2, -1), norm="backward", **kw):
+        return jfn(x, s=s, axes=tuple(axes), norm=_norm(norm))
+    op.__name__ = name
+    return op
+
+
+def _mkn(jfn, name):
+    @wrap_op
+    def op(x, s=None, axes=None, norm="backward", **kw):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+    op.__name__ = name
+    return op
+
+
+fft = _mk1(jnp.fft.fft, "fft")
+ifft = _mk1(jnp.fft.ifft, "ifft")
+rfft = _mk1(jnp.fft.rfft, "rfft")
+irfft = _mk1(jnp.fft.irfft, "irfft")
+hfft = _mk1(jnp.fft.hfft, "hfft")
+ihfft = _mk1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _mk2(jnp.fft.fft2, "fft2")
+ifft2 = _mk2(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2(jnp.fft.irfft2, "irfft2")
+
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def _hfft_nd(x, s, axes, norm, default_all_axes):
+    # hfftN = fftN over the leading axes, then hfft over the last
+    # (verified against scipy.fft.hfft2 — an ifftN leading stage is NOT
+    # the correct decomposition)
+    if axes is None:
+        axes = tuple(range(x.ndim)) if default_all_axes else (-2, -1)
+    axes = tuple(axes)
+    lead = jnp.fft.fftn(x, s=None if s is None else tuple(s)[:-1],
+                        axes=axes[:-1], norm=_norm(norm))
+    return jnp.fft.hfft(lead, n=None if s is None else tuple(s)[-1],
+                        axis=axes[-1], norm=_norm(norm))
+
+
+def _ihfft_nd(x, s, axes, norm, default_all_axes):
+    if axes is None:
+        axes = tuple(range(x.ndim)) if default_all_axes else (-2, -1)
+    axes = tuple(axes)
+    tail = jnp.fft.ihfft(x, n=None if s is None else tuple(s)[-1],
+                         axis=axes[-1], norm=_norm(norm))
+    return jnp.fft.ifftn(tail, s=None if s is None else tuple(s)[:-1],
+                         axes=axes[:-1], norm=_norm(norm))
+
+
+@wrap_op
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _hfft_nd(x, s, axes, norm, default_all_axes=False)
+
+
+@wrap_op
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _ihfft_nd(x, s, axes, norm, default_all_axes=False)
+
+
+@wrap_op
+def hfftn(x, s=None, axes=None, norm="backward"):
+    return _hfft_nd(x, s, axes, norm, default_all_axes=True)
+
+
+@wrap_op
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    return _ihfft_nd(x, s, axes, norm, default_all_axes=True)
+
+
+@wrap_op
+def fftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return out if dtype is None else out.astype(dtype)
+
+
+@wrap_op
+def rfftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return out if dtype is None else out.astype(dtype)
+
+
+@wrap_op
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@wrap_op
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)

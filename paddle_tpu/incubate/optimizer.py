@@ -1,0 +1,140 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage (reference:
+python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}).
+
+Both wrap an inner optimizer on the eager path: LookAhead keeps slow copies
+of the parameters and interpolates every k steps (Zhang et al. 2019);
+ModelAverage maintains a running average of parameters applied for
+evaluation (apply/restore)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """lookahead.py: slow_t+1 = slow_t + alpha * (fast - slow_t) every k
+    inner steps; fast weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if not p.stop_gradient]
+
+    def step(self):
+        if not self._slow:
+            # slow weights start at the step-0 parameters (BEFORE the first
+            # inner update), so the first sync at step k interpolates
+            # slow_0 + alpha*(fast_k - slow_0) like the reference
+            for p in self._params():
+                self._slow[id(p)] = p._array
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self._params():
+            slow = self._slow.get(id(p), p._array)
+            slow = slow + self.alpha * (p._array - slow)
+            self._slow[id(p)] = slow
+            p._array = jnp.asarray(slow).astype(p._array.dtype)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        import numpy as np
+        # slow copies keyed by parameter ORDER (ids are process-local)
+        slow = {str(i): np.asarray(self._slow[id(p)])
+                for i, p in enumerate(self._params()) if id(p) in self._slow}
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_num": self._step_num, "slow": slow}
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd["inner"])
+        self._step_num = sd.get("step_num", 0)
+        self._slow = {}
+        for i, p in enumerate(self._params()):
+            if str(i) in sd.get("slow", {}):
+                self._slow[id(p)] = jnp.asarray(sd["slow"][str(i)])
+
+
+class ModelAverage:
+    """modelaverage.py: running parameter average over a sliding window;
+    ``apply()`` swaps averaged params in for evaluation, ``restore()``
+    swaps the training params back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameters = list(parameters or [])
+        self._sum = {}
+        self._count = {}
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values into the average."""
+        for p in self._parameters:
+            if p.stop_gradient:
+                continue
+            pid = id(p)
+            n = self._count.get(pid, 0)
+            window = max(self.min_average_window,
+                         min(self.max_average_window,
+                             int(n * self.average_window_rate) or 1))
+            if n >= window:
+                # slide: decay old contribution (restart accumulation)
+                self._sum[pid] = self._sum[pid] * (window - 1) / window
+                n = window - 1
+            acc = self._sum.get(pid)
+            self._sum[pid] = p._array.astype(jnp.float32) if acc is None \
+                else acc + p._array.astype(jnp.float32)
+            self._count[pid] = n + 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged parameters in (for evaluation)."""
+        self._backup = {}
+        for p in self._parameters:
+            pid = id(p)
+            if pid not in self._sum:
+                continue
+            self._backup[pid] = p._array
+            avg = self._sum[pid] / self._count[pid]
+            p._array = avg.astype(p._array.dtype)
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        """Swap the training parameters back after apply()."""
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            pid = id(p)
+            if pid in self._backup:
+                p._array = self._backup[pid]
+        self._backup = None
+
+    def minimize(self, loss, **kw):
+        raise NotImplementedError(
+            "ModelAverage tracks parameters; call step() after the inner "
+            "optimizer's step()")

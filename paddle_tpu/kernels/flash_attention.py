@@ -32,6 +32,10 @@ def supported(q, k=None) -> bool:
     the kernel's causal mask is start-aligned and a ragged key tail would be
     silently dropped — cross/cached attention takes the XLA reference path.
     """
+    import os
+    if os.getenv("PADDLE_TPU_DISABLE_FLASH", "").lower() in ("1", "true",
+                                                             "yes"):
+        return False
     if _platform() != "tpu":
         return False
     if q.ndim != 4:

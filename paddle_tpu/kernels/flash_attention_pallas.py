@@ -15,14 +15,31 @@ Both accumulate in fp32 and write grads in the input dtype.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+def _block_env(name, default):
+    """Power-of-two >=128 only: the divisibility-fallback loop in
+    flash_attention_bhsd halves the block until it divides the sequence, so
+    a non-power-of-two would turn supported() shapes into dispatch errors."""
+    raw = os.getenv(name)
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    if v < 128 or v & (v - 1):
+        return default
+    return v
+
+
+DEFAULT_BLOCK_Q = _block_env("PADDLE_TPU_FLASH_BLOCK_Q", 512)
+DEFAULT_BLOCK_K = _block_env("PADDLE_TPU_FLASH_BLOCK_K", 512)
 _NEG_INF = -1e30
 
 

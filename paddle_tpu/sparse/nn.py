@@ -1,0 +1,19 @@
+"""paddle.sparse.nn — sparse layers (reference:
+python/paddle/sparse/nn at v2.3-dev: ReLU + functional)."""
+from __future__ import annotations
+
+
+class ReLU:
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, x):
+        from . import relu
+        return relu(x)
+
+
+class functional:
+    @staticmethod
+    def relu(x):
+        from . import relu as _relu
+        return _relu(x)

@@ -1,0 +1,115 @@
+"""DenseNet (reference parity: python/paddle/vision/models/densenet.py —
+densely connected blocks, Huang et al. 2017).  jnp-native rewrite: dense
+connectivity via channel concat; bottleneck 1x1 -> 3x3 layers."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return ops.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """layers in {121, 161, 169, 201, 264} (reference densenet.py)."""
+
+    _cfgs = {
+        121: (64, 32, (6, 12, 24, 16)),
+        161: (96, 48, (6, 12, 36, 24)),
+        169: (64, 32, (6, 12, 32, 32)),
+        201: (64, 32, (6, 12, 48, 32)),
+        264: (64, 32, (6, 12, 64, 48)),
+    }
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in self._cfgs:
+            raise ValueError(f"supported layers: {sorted(self._cfgs)}, "
+                             f"got {layers}")
+        num_init, growth, block_cfg = self._cfgs[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        c = num_init
+        features = []
+        for bi, n_layers in enumerate(block_cfg):
+            for _ in range(n_layers):
+                features.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if bi != len(block_cfg) - 1:
+                features.append(_Transition(c, c // 2))
+                c //= 2
+        features.append(nn.BatchNorm2D(c))
+        features.append(nn.ReLU())
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled in the TPU build")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,211 @@
+"""fft / sparse / new vision families / incubate optimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# -- fft ---------------------------------------------------------------------
+
+def test_fft_roundtrip_and_norms():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.complex64)
+    got = paddle.fft.fft(paddle.to_tensor(x.real)).numpy()
+    want = np.fft.fft(x.real, axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # ifft(fft(x)) == x
+    t = paddle.to_tensor(x.real)
+    rt = paddle.fft.ifft(paddle.fft.fft(t)).numpy()
+    np.testing.assert_allclose(rt.real, x.real, rtol=1e-4, atol=1e-4)
+    # ortho norm matches numpy
+    got = paddle.fft.fft(t, norm="ortho").numpy()
+    np.testing.assert_allclose(got, np.fft.fft(x.real, norm="ortho"),
+                               rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        paddle.fft.fft(t, norm="bogus")
+
+
+def test_rfft_irfft_2d_n():
+    x = np.random.RandomState(1).randn(3, 8, 8).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.fft.rfft(t).numpy(),
+                               np.fft.rfft(x, axis=-1).astype(np.complex64),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.irfft(paddle.fft.rfft(t)).numpy(),
+                               x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.fft2(t).numpy(),
+                               np.fft.fft2(x).astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(paddle.fft.fftn(t).numpy(),
+                               np.fft.fftn(x).astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_hfft2_ihfft2_vs_scipy():
+    import scipy.fft as sfft
+    x = (np.random.RandomState(2).randn(4, 5)
+         + 1j * np.random.RandomState(3).randn(4, 5)).astype(np.complex64)
+    got = paddle.fft.hfft2(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, sfft.hfft2(x), rtol=1e-3, atol=1e-3)
+    y = np.random.RandomState(4).randn(4, 8).astype(np.float32)
+    got = paddle.fft.ihfft2(paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(got, sfft.ihfft2(y), rtol=1e-3, atol=1e-3)
+    # hfftn default axes=None means all axes (must not crash)
+    z = np.random.RandomState(5).randn(3, 4, 5).astype(np.complex64)
+    got = paddle.fft.hfftn(paddle.to_tensor(z)).numpy()
+    np.testing.assert_allclose(got, sfft.hfftn(z), rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_divide_keeps_indices():
+    a = paddle.sparse.sparse_coo_tensor([[1], [1]], [4.0], shape=[2, 2])
+    b = paddle.sparse.sparse_coo_tensor([[1], [1]], [2.0], shape=[2, 2])
+    out = paddle.sparse.divide(a, b)
+    dense = out.to_dense().numpy()
+    np.testing.assert_allclose(dense, [[0, 0], [0, 2.0]])
+
+
+def test_fftfreq_shift():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    x = np.arange(8.0, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+    np.testing.assert_allclose(
+        paddle.fft.ifftshift(paddle.to_tensor(np.fft.fftshift(x))).numpy(), x)
+
+
+# -- sparse ------------------------------------------------------------------
+
+def test_sparse_coo_basics():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert s.is_sparse_coo() and not s.is_sparse_csr()
+    assert s.nnz == 3
+    dense = s.to_dense().numpy()
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, want)
+
+
+def test_sparse_csr_roundtrip():
+    crows = [0, 2, 3, 5]
+    cols = [0, 2, 1, 0, 2]
+    values = [1.0, 2.0, 3.0, 4.0, 5.0]
+    s = paddle.sparse.sparse_csr_tensor(crows, cols, values, [3, 3])
+    assert s.is_sparse_csr()
+    dense = s.to_dense().numpy()
+    want = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+    np.testing.assert_allclose(dense, want)
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), want)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), want)
+
+
+def test_sparse_ops():
+    a = paddle.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-2.0, 4.0],
+                                        shape=[2, 2])
+    r = paddle.sparse.relu(a).to_dense().numpy()
+    np.testing.assert_allclose(r, [[0, 0], [0, 4]])
+    m = paddle.sparse.matmul(a, paddle.to_tensor(np.eye(2, dtype=np.float32)))
+    np.testing.assert_allclose(m.numpy(), [[-2, 0], [0, 4]])
+    b = paddle.sparse.sparse_coo_tensor([[0], [1]], [10.0], shape=[2, 2])
+    s = paddle.sparse.add(a, b).to_dense().numpy()
+    np.testing.assert_allclose(s, [[-2, 10], [0, 4]])
+
+
+# -- vision families ---------------------------------------------------------
+
+@pytest.mark.parametrize("ctor,outshape", [
+    ("densenet121", (2, 10)),
+    ("squeezenet1_1", (2, 10)),
+    ("shufflenet_v2_x0_25", (2, 10)),
+    ("mobilenet_v3_small", (2, 10)),
+])
+@pytest.mark.slow
+def test_vision_forward_shapes(ctor, outshape):
+    from paddle_tpu.vision import models
+    net = getattr(models, ctor)(num_classes=10)
+    net.eval()
+    x = paddle.randn([2, 3, 64, 64])
+    out = net(x)
+    assert tuple(out.shape) == outshape
+
+
+@pytest.mark.slow
+def test_googlenet_aux_heads():
+    from paddle_tpu.vision.models import googlenet
+    net = googlenet(num_classes=10)
+    net.eval()
+    out, aux1, aux2 = net(paddle.randn([2, 3, 96, 96]))
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(aux1.shape) == (2, 10) and tuple(aux2.shape) == (2, 10)
+
+
+@pytest.mark.slow
+def test_inception_v3_forward():
+    from paddle_tpu.vision.models import inception_v3
+    net = inception_v3(num_classes=10)
+    net.eval()
+    out = net(paddle.randn([2, 3, 299, 299]))
+    assert tuple(out.shape) == (2, 10)
+
+
+# -- incubate optimizers -----------------------------------------------------
+
+def test_lookahead_interpolates():
+    net = nn.Linear(4, 4)
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 4])
+
+    def one_step():
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    l0 = one_step()
+    w_fast_like = net.weight.numpy().copy()   # after 1 inner step, no sync
+    l1 = one_step()                            # k=2: first sync happens here
+    # the sync must PULL params toward the step-0 weights:
+    # w = w0 + 0.5*(fast - w0) != fast
+    fast_alone = w_fast_like  # not exactly fast_2, but the pull must differ
+    assert not np.allclose(net.weight.numpy(), fast_alone)
+    losses = [one_step() for _ in range(4)]
+    assert losses[-1] < l0
+    # state_dict round-trips the slow copies
+    sd = opt.state_dict()
+    assert sd["slow"], "slow weights must be checkpointed"
+    opt2 = paddle.incubate.LookAhead(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters()), alpha=0.5, k=2)
+    opt2.set_state_dict(sd)
+    assert opt2._slow and opt2._step_num == opt._step_num
+
+
+def test_model_average_apply_restore():
+    net = nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    ma = paddle.incubate.ModelAverage(parameters=net.parameters(),
+                                      min_average_window=2,
+                                      max_average_window=10)
+    x = paddle.randn([8, 4]); y = paddle.randn([8, 2])
+    for _ in range(4):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        inner.step()
+        inner.clear_grad()
+        ma.step()
+    before = net.weight.numpy().copy()
+    ma.apply()
+    averaged = net.weight.numpy().copy()
+    assert not np.allclose(before, averaged)  # average != last iterate
+    ma.restore()
+    np.testing.assert_allclose(net.weight.numpy(), before)
