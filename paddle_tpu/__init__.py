@@ -54,6 +54,8 @@ from . import utils         # noqa: E402
 from . import incubate      # noqa: E402
 from . import fft           # noqa: E402
 from . import sparse        # noqa: E402
+from . import text          # noqa: E402
+from . import onnx          # noqa: E402
 from . import profiler      # noqa: E402
 from . import hapi          # noqa: E402
 from .hapi import Model     # noqa: E402

@@ -29,27 +29,24 @@ def _norm(norm):
 
 
 def _mk1(jfn, name):
-    @wrap_op
     def op(x, n=None, axis=-1, norm="backward", **kw):
         return jfn(x, n=n, axis=axis, norm=_norm(norm))
     op.__name__ = name
-    return op
+    return wrap_op(op, name=name)
 
 
 def _mk2(jfn, name):
-    @wrap_op
     def op(x, s=None, axes=(-2, -1), norm="backward", **kw):
         return jfn(x, s=s, axes=tuple(axes), norm=_norm(norm))
     op.__name__ = name
-    return op
+    return wrap_op(op, name=name)
 
 
 def _mkn(jfn, name):
-    @wrap_op
     def op(x, s=None, axes=None, norm="backward", **kw):
         return jfn(x, s=s, axes=axes, norm=_norm(norm))
     op.__name__ = name
-    return op
+    return wrap_op(op, name=name)
 
 
 fft = _mk1(jnp.fft.fft, "fft")

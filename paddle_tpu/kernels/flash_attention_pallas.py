@@ -79,36 +79,47 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
         jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        start = jax.lax.mul(kb, _i32(block_k))
-        k = k_ref[0, pl.ds(start, block_k), :]
-        v = v_ref[0, pl.ds(start, block_k), :]
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * jnp.float32(scale)
-        if causal:
-            col_ids = start[None, None] + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            logits = jnp.where(col_ids <= row_ids, logits,
-                               jnp.float32(_NEG_INF))
-        blk_max = jnp.max(logits, axis=-1)
-        new_m = jnp.maximum(m, blk_max)
-        correction = jnp.exp(m - new_m)
-        p = jnp.exp(logits - new_m[:, None])
-        new_l = l * correction + jnp.sum(p, axis=-1)
-        new_acc = acc * correction[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return new_m, new_l, new_acc
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, acc = carry
+            start = jax.lax.mul(kb, _i32(block_k))
+            k = k_ref[0, pl.ds(start, block_k), :]
+            v = v_ref[0, pl.ds(start, block_k), :]
+            logits = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            if masked:
+                col_ids = start[None, None] + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                logits = jnp.where(col_ids <= row_ids, logits,
+                                   jnp.float32(_NEG_INF))
+            blk_max = jnp.max(logits, axis=-1)
+            new_m = jnp.maximum(m, blk_max)
+            correction = jnp.exp(m - new_m)
+            p = jnp.exp(logits - new_m[:, None])
+            new_l = l * correction + jnp.sum(p, axis=-1)
+            new_acc = acc * correction[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return new_m, new_l, new_acc
+        return body
 
     if causal:
         assert block_q % block_k == 0
-        num_kb = jax.lax.mul(jax.lax.add(qi, _i32(1)),
-                             _i32(block_q // block_k))
+        # visible blocks split into fully-visible (no mask arithmetic — the
+        # where/iota VPU work is ~half the kernel at these shapes) and the
+        # diagonal band (block_q//block_k partially masked blocks)
+        ratio = _i32(block_q // block_k)
+        num_full = jax.lax.mul(qi, ratio)
+        carry = jax.lax.fori_loop(_i32(0), num_full, make_body(False),
+                                  (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(num_full,
+                                      jax.lax.add(num_full, ratio),
+                                      make_body(True), carry)
     else:
         num_kb = _i32(s // block_k)
-    m, l, acc = jax.lax.fori_loop(_i32(0), num_kb, body, (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(_i32(0), num_kb, make_body(False),
+                                      (m0, l0, acc0))
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0, pl.ds(qi, 1), :] = (m + jnp.log(l_safe))[None, :]
@@ -156,106 +167,86 @@ def _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, causal, scale, block_k):
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    s = k_ref.shape[1]
-    qi = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc, *,
+                causal, scale, nq, nk):
+    """Merged FlashAttention-2 backward: ONE kernel produces dQ, dK and dV.
 
-    q = q_ref[0]                              # (BQ, D) input dtype
-    do = do_ref[0]                            # (BQ, D) input dtype
-    lse = lse_ref[0, pl.ds(qi, 1), :][0]      # (BQ,) f32
-    delta = delta_ref[0, pl.ds(qi, 1), :][0]  # (BQ,) f32
-
-    row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
-        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(kb, dq_acc):
-        start = jax.lax.mul(kb, _i32(block_k))
-        k = k_ref[0, pl.ds(start, block_k), :]
-        v = v_ref[0, pl.ds(start, block_k), :]
-        logits = jnp.float32(scale) * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        p = jnp.exp(logits - lse[:, None])
-        if causal:
-            col_ids = start[None, None] + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(col_ids <= row_ids, p, jnp.float32(0.0))
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (BQ, BK)
-        ds = (p * (dp - delta[:, None])).astype(k.dtype)
-        return dq_acc + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        num_kb = jax.lax.mul(jax.lax.add(qi, _i32(1)),
-                             _i32(block_q // block_k))
-    else:
-        num_kb = _i32(s // block_k)
-    dq = jax.lax.fori_loop(_i32(0), num_kb, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (jnp.float32(scale) * dq).astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, causal, scale, block_q):
+    The textbook two-kernel split (dQ over q-blocks, dK/dV over k-blocks)
+    recomputes the logits and dP matmuls twice; merging halves that
+    recompute and saves a kernel launch per layer.  Grid = (bh, nk, nq),
+    both inner dims sequential: dK/dV accumulate per key block in scratch
+    (reset at qi==0), while dQ accumulates across the WHOLE (nk, nq) sweep
+    in a full-sequence f32 scratch, written once at the final step.
+    q/do (1, BQ, D) stream with qi; k/v (1, BK, D) with ki; lse/delta come
+    in the folded (1, NQ, BQ) row layout (see _fwd_kernel)."""
     block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    s = q_ref.shape[1]
+    block_q = q_ref.shape[1]
     ki = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+    qi = jax.lax.convert_element_type(pl.program_id(2), jnp.int32)
 
-    k = k_ref[0]                              # (BK, D) input dtype
-    v = v_ref[0]                              # (BK, D) input dtype
+    @pl.when(jnp.logical_and(ki == 0, qi == 0))
+    def _init_dq():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
-        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        start = jax.lax.mul(qb, _i32(block_q))
-        q = q_ref[0, pl.ds(start, block_q), :]
-        do = do_ref[0, pl.ds(start, block_q), :]
-        lse = lse_ref[0, pl.ds(qb, 1), :][0]
-        delta = delta_ref[0, pl.ds(qb, 1), :][0]
+    live = True
+    if causal:
+        # the block is fully masked iff even its last row precedes the
+        # first key column
+        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
+            jax.lax.mul(ki, _i32(block_k))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                              # (BQ, D) input dtype
+        k = k_ref[0]                              # (BK, D)
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, pl.ds(qi, 1), :][0]      # (BQ,) f32
+        delta = delta_ref[0, pl.ds(qi, 1), :][0]  # (BQ,) f32
         logits = jnp.float32(scale) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (BQ, BK)
+            preferred_element_type=jnp.float32)   # (BQ, BK)
         p = jnp.exp(logits - lse[:, None])
         if causal:
-            row_ids = start[None, None] + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             p = jnp.where(col_ids <= row_ids, p, jnp.float32(0.0))
         pc = p.astype(do.dtype)
         # dV += P^T dO
-        dv_acc = dv_acc + jax.lax.dot_general(
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
             pc, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (BK, D)
+            preferred_element_type=jnp.float32)   # (BK, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (BQ, BK)
+            preferred_element_type=jnp.float32)   # (BQ, BK)
         ds = (p * (dp - delta[:, None])).astype(q.dtype)
         # dK += dS^T Q
-        dk_acc = dk_acc + jax.lax.dot_general(
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (BK, D)
-        return dk_acc, dv_acc
+            preferred_element_type=jnp.float32)   # (BK, D)
+        # dQ rows qi += dS K
+        row0 = jax.lax.mul(qi, _i32(block_q))
+        dq_sc[pl.ds(row0, block_q), :] = \
+            dq_sc[pl.ds(row0, block_q), :] + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
-    if causal:
-        assert block_q % block_k == 0 or block_k % block_q == 0
-        # first query block that can see this key block
-        start_qb = jax.lax.div(jax.lax.mul(ki, _i32(block_k)),
-                               _i32(block_q))
-    else:
-        start_qb = _i32(0)
-    nq = _i32(s // block_q)
-    zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_qb, nq, body, (zeros, zeros))
-    dk_ref[0] = (jnp.float32(scale) * dk).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = (jnp.float32(scale) * dk_sc[...]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+    @pl.when(jnp.logical_and(ki == nk - 1, qi == nq - 1))
+    def _finalize_q():
+        dq_ref[0] = (jnp.float32(scale) * dq_sc[...]).astype(dq_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
@@ -282,43 +273,32 @@ def _flash_bwd_inner(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                      o.reshape(bh, s, d).astype(jnp.float32),
                      axis=-1).reshape(bh, nq, block_q)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k),
-        grid=(bh, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q),
-        grid=(bh, nk),
-        in_specs=[
-            pl.BlockSpec((1, s, d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, s, d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
-        ],
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, i, 0))
+    row_spec = pl.BlockSpec((1, nq, block_q), lambda bi, i, j: (bi, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, causal=causal, scale=scale,
+                          nq=nq, nk=nk),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bi, i: (bi, i, 0)),
+            # dq: whole-sequence block, revisited; written at the last step
+            pl.BlockSpec((1, s, d), lambda bi, i, j: (bi, 0, 0)),
+            k_spec,
+            k_spec,
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((s, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, do3, lse3, delta3)
 

@@ -234,14 +234,23 @@ class GPTPretrainingCriterion(Layer):
     criterion)."""
 
     def forward(self, logits, labels, loss_mask=None):
-        shifted = logits[:, :-1, :]
-        targets = labels[:, 1:]
-        loss = F.cross_entropy(shifted, targets, reduction="none")
+        # shift via the LABELS, not the logits: slicing logits[:, :-1, :]
+        # copies the whole (B, S, V) array (~1GB of HBM traffic at GPT-2
+        # bench shapes); rolling the small int labels and masking position
+        # S-1 with ignore_index computes the same loss without it
+        b, s = labels.shape[0], labels.shape[1]
+        targets = ops.concat(
+            [labels[:, 1:], ops.full([b, 1], -100, labels.dtype)], axis=1)
+        loss = F.cross_entropy(logits, targets, reduction="none",
+                               ignore_index=-100)
+        denom = float(s - 1) / float(s)  # mean over the S-1 real positions
         if loss_mask is not None:
-            mask = loss_mask[:, 1:]
+            mask = ops.concat(
+                [loss_mask[:, 1:], ops.zeros([b, 1], loss_mask.dtype)],
+                axis=1)
             return ops.sum(loss * mask) / ops.maximum(
                 ops.sum(mask), ops.to_tensor(1.0))
-        return ops.mean(loss)
+        return ops.mean(loss) / denom
 
 
 def gpt2_345m():

@@ -1,0 +1,29 @@
+"""paddle.onnx — model export namespace (reference:
+python/paddle/onnx/export.py, which delegates to the external paddle2onnx
+package).
+
+The TPU build's portable serving artifact is the jax.export/StableHLO module
+written by ``paddle.static.save_inference_model`` / ``paddle.jit.save`` —
+StableHLO is the interchange format of the XLA ecosystem the way ONNX is for
+the CUDA runtimes.  ONNX serialization itself needs the onnx package, which
+is not bundled; ``export`` raises with that guidance unless onnx is
+importable.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "paddle.onnx.export requires the 'onnx' package, which is not "
+            "bundled in the TPU build.  Use paddle.jit.save / "
+            "paddle.static.save_inference_model instead: they produce a "
+            "standalone StableHLO artifact (the XLA-native equivalent) "
+            "loadable with paddle.jit.load in any process.")
+    raise NotImplementedError(
+        "ONNX graph emission from jaxpr is not implemented; export via "
+        "jit.save (StableHLO) and convert externally if ONNX is required.")

@@ -185,12 +185,13 @@ def _coo(x) -> jsparse.BCOO:
 
 def _unary(fn, x):
     """Elementwise op applied to stored values only (zeros preserved —
-    valid for fn with fn(0)=0, the reference's sparse unary set)."""
-    bcoo = _coo(x)
-    out = jsparse.BCOO((fn(bcoo.data), bcoo.indices), shape=bcoo.shape)
+    valid for fn with fn(0)=0, the reference's sparse unary set).
+    Pattern-preserving: O(nnz), stays on device for both layouts."""
     if isinstance(x, SparseCsrTensor):
-        return SparseCooTensor(out).to_sparse_csr()
-    return SparseCooTensor(out)
+        return SparseCsrTensor(x.crows_, x.cols_, fn(x.values_), x._shape)
+    bcoo = _coo(x)
+    return SparseCooTensor(
+        jsparse.BCOO((fn(bcoo.data), bcoo.indices), shape=bcoo.shape))
 
 
 def relu(x):

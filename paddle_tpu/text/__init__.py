@@ -1,0 +1,329 @@
+"""paddle.text — NLP datasets + viterbi decode (reference surface:
+python/paddle/text/: Imdb, Imikolov, Movielens, UCIHousing, Conll05st,
+WMT14, WMT16 datasets; paddle.text.viterbi_decode landed in the same cycle).
+
+Zero-egress environment: like vision.datasets, every dataset falls back to
+deterministic synthetic data with the real field structure/cardinality when
+no source file is supplied, so pipelines run unchanged.  UCIHousing and
+Imikolov parse real data files when given; the archive-format datasets
+raise loudly rather than silently substituting random data for a user's
+real corpus.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+
+def _no_parser(cls_name, data_file):
+    if data_file is not None and os.path.exists(data_file):
+        raise NotImplementedError(
+            f"{cls_name}: parsing the original archive format is not "
+            "implemented in the TPU build — refusing to silently train on "
+            "synthetic data while a real corpus was supplied. Pass "
+            "data_file=None to opt into the synthetic dataset.")
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (token_ids, label) pairs
+    (reference: text/datasets/imdb.py)."""
+
+    VOCAB_SIZE = 5147
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True, synthetic_size=None):
+        _no_parser("Imdb", data_file)
+        self.mode = mode
+        n = synthetic_size or (2048 if mode == "train" else 512)
+        rng = np.random.RandomState(50 if mode == "train" else 51)
+        lens = rng.randint(16, 200, n)
+        self.docs = [rng.randint(1, self.VOCAB_SIZE, l).astype(np.int64)
+                     for l in lens]
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB_SIZE)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference: text/datasets/imikolov.py)."""
+
+    VOCAB_SIZE = 2074
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True,
+                 synthetic_size=None):
+        self.window_size = window_size
+        if data_file is not None and os.path.exists(data_file):
+            # real PTB-style corpus: one sentence per line, whitespace tokens
+            from collections import Counter
+            with open(data_file) as f:
+                lines = [l.split() for l in f]
+            freq = Counter(w for l in lines for w in l)
+            vocab = [w for w, c in freq.most_common() if c >= min_word_freq]
+            self.word_idx = {w: i for i, w in enumerate(vocab)}
+            unk = len(self.word_idx)
+            grams = []
+            for l in lines:
+                ids = [self.word_idx.get(w, unk) for w in l]
+                for i in range(len(ids) - window_size + 1):
+                    grams.append(ids[i:i + window_size])
+            self.data = np.asarray(grams, np.int64) if grams else \
+                np.zeros((0, window_size), np.int64)
+            return
+        n = synthetic_size or (4096 if mode == "train" else 1024)
+        rng = np.random.RandomState(52 if mode == "train" else 53)
+        self.data = rng.randint(0, self.VOCAB_SIZE,
+                                (n, window_size)).astype(np.int64)
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB_SIZE)}
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return tuple(row[:-1]), row[-1]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """Rating prediction records (reference: text/datasets/movielens.py)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True, synthetic_size=None):
+        _no_parser("Movielens", data_file)
+        n = synthetic_size or (4096 if mode == "train" else 512)
+        rng = np.random.RandomState(54 if mode == "train" else 55)
+        self.user_id = rng.randint(1, 6041, n).astype(np.int64)
+        self.gender = rng.randint(0, 2, n).astype(np.int64)
+        self.age = rng.randint(0, 7, n).astype(np.int64)
+        self.job = rng.randint(0, 21, n).astype(np.int64)
+        self.movie_id = rng.randint(1, 3953, n).astype(np.int64)
+        self.category = [rng.randint(0, 18, rng.randint(1, 4)).astype(
+            np.int64) for _ in range(n)]
+        self.title = [rng.randint(0, 5175, rng.randint(1, 6)).astype(
+            np.int64) for _ in range(n)]
+        self.rating = rng.randint(1, 6, n).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (self.user_id[idx], self.gender[idx], self.age[idx],
+                self.job[idx], self.movie_id[idx], self.category[idx],
+                self.title[idx], self.rating[idx])
+
+    def __len__(self):
+        return len(self.rating)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (reference: text/datasets/uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 synthetic_size=None):
+        if data_file is not None and os.path.exists(data_file):
+            # real UCI housing file: 14 whitespace-separated floats per row
+            raw = np.loadtxt(data_file, dtype=np.float32)
+            if raw.ndim != 2 or raw.shape[1] != 14:
+                raise ValueError(
+                    f"UCIHousing: expected rows of 14 floats, got shape "
+                    f"{raw.shape}")
+            split = int(len(raw) * 0.8)
+            part = raw[:split] if mode == "train" else raw[split:]
+            self.features = part[:, :13]
+            self.prices = part[:, 13:14]
+            return
+        n = synthetic_size or (404 if mode == "train" else 102)
+        rng = np.random.RandomState(56 if mode == "train" else 57)
+        self.features = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.prices = (self.features @ w +
+                       rng.randn(n).astype(np.float32) * 0.1)[:, None]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.prices)
+
+
+class Conll05st(Dataset):
+    """SRL sequence-labeling records (reference: text/datasets/conll05.py)."""
+
+    WORD_DICT = 44068
+    LABEL_DICT = 59
+    PRED_DICT = 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True, synthetic_size=None):
+        _no_parser("Conll05st", data_file)
+        n = synthetic_size or 1024
+        rng = np.random.RandomState(58)
+        lens = rng.randint(5, 40, n)
+        self.samples = []
+        for l in lens:
+            words = rng.randint(0, self.WORD_DICT, l).astype(np.int64)
+            pred = rng.randint(0, self.PRED_DICT, l).astype(np.int64)
+            labels = rng.randint(0, self.LABEL_DICT, l).astype(np.int64)
+            self.samples.append((words, pred, labels))
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(100)},
+                {f"v{i}": i for i in range(100)},
+                {f"l{i}": i for i in range(self.LABEL_DICT)})
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, src_dict_size, trg_dict_size, mode, lang,
+                 synthetic_size):
+        n = synthetic_size or (2048 if mode == "train" else 256)
+        rng = np.random.RandomState(60 if mode == "train" else 61)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        lens = rng.randint(4, 50, n)
+        self.samples = []
+        for l in lens:
+            src = rng.randint(3, src_dict_size, l).astype(np.int64)
+            trg = rng.randint(3, trg_dict_size, max(2, l + rng.randint(-3, 4))
+                              ).astype(np.int64)
+            self.samples.append((src, np.concatenate([[self.BOS], trg]),
+                                 np.concatenate([trg, [self.EOS]])))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py (en-fr)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True, synthetic_size=None):
+        _no_parser("WMT14", data_file)
+        super().__init__(dict_size, dict_size, mode, "en-fr", synthetic_size)
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py (en-de)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", download=True,
+                 synthetic_size=None):
+        _no_parser("WMT16", data_file)
+        super().__init__(src_dict_size, trg_dict_size, mode, lang,
+                         synthetic_size)
+
+
+# ---------------------------------------------------------------------------
+# viterbi decode (reference: paddle.text.viterbi_decode, the CRF decode op
+# paddle/fluid/operators/viterbi_decode_op.*)
+# ---------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decode (reference: paddle.text.viterbi_decode,
+    viterbi_decode_op.cc).
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N) with
+    the SAME N.  With ``include_bos_eos_tag=True`` the last two tags are the
+    virtual BOS/EOS tags (reference semantics): ``transition[-2, :]`` scores
+    the first step, ``transition[:, -1]`` the last.  Returns
+    (scores (B,), paths (B, T)).
+
+    TPU-native: one lax.scan over time — compiled, no Python loop per step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    def arr(x):
+        return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+    pots = arr(potentials).astype(jnp.float32)
+    trans = arr(transition_params).astype(jnp.float32)
+    b, t, n = pots.shape
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = arr(lengths).astype(jnp.int32)
+
+    if trans.shape != (n, n):
+        raise ValueError(
+            f"transition_params must be (num_tags, num_tags) = ({n}, {n}) "
+            f"matching potentials' last dim; got {tuple(trans.shape)}")
+    if include_bos_eos_tag:
+        # last two tags are the virtual BOS/EOS tags: row -2 scores the
+        # first step, column -1 the last (same N as the potentials)
+        start = trans[-2, :][None, :]
+        stop = trans[:, -1][None, :]
+    else:
+        start = jnp.zeros((1, n), jnp.float32)
+        stop = jnp.zeros((1, n), jnp.float32)
+
+    alpha0 = pots[:, 0, :] + start
+
+    def step(carry, inp):
+        alpha, step_i = carry
+        emit = inp                                # (B, N)
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)    # (B, N)
+        best_score = jnp.max(scores, axis=1) + emit
+        # positions past a sequence's length keep their alpha, and their
+        # backpointers become identity so the backward trace passes through
+        active = (step_i < lens)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        identity = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+        return (new_alpha, step_i + 1), jnp.where(active, best_prev,
+                                                  identity)
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.ones((), jnp.int32)),
+        jnp.moveaxis(pots[:, 1:, :], 1, 0))
+    final = alpha + stop
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    def backward(carry, ptrs):
+        tag = carry  # tag at time t+1 while processing backptr index t
+        prev = jnp.take_along_axis(ptrs, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    # reverse scan: outputs land at their original indices, so
+    # path_rev[t] = tag_{t+1}; the final carry is the time-0 tag
+    first_tag, path_rev = jax.lax.scan(backward, last_tag, backptrs,
+                                       reverse=True)
+    paths = jnp.concatenate([first_tag[:, None],
+                             jnp.moveaxis(path_rev, 0, 1)], axis=1)
+    return Tensor(scores), Tensor(paths)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

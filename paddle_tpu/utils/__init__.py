@@ -1,6 +1,7 @@
 """Utilities (reference surface: python/paddle/utils/)."""
 from __future__ import annotations
 
+from . import cpp_extension  # noqa: F401
 from . import flags  # noqa: F401
 from . import unique_name  # noqa: F401
 

@@ -1,0 +1,201 @@
+"""Custom-op / extension mechanism (reference:
+python/paddle/utils/cpp_extension/ — CppExtension/CUDAExtension/load — and
+paddle/fluid/framework/custom_operator.cc load_op_library).
+
+TPU-native split:
+* **Pallas / JAX custom ops** (:func:`register_op`) — the analogue of
+  CUDAExtension: a raw jax-array function (typically a
+  ``pl.pallas_call`` kernel) registered under a name becomes a first-class
+  eager op on ``paddle_tpu.ops`` (tape autograd via jax.vjp, or a hand
+  written backward via ``grad_fn`` = jax.custom_vjp), usable inside jit
+  traces through ``.raw`` like every built-in op.
+* **C++ host extensions** (:func:`load`) — the CppExtension analogue:
+  compiles C++ sources into a shared library with g++ and exposes chosen
+  C-ABI symbols through ctypes.  Host-side code (IO, tokenizers, custom
+  data transforms) runs on CPU; device compute belongs in Pallas.
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+
+__all__ = ["register_op", "get_op", "registered_ops", "load",
+           "CppExtension", "CUDAExtension", "setup"]
+
+
+# ---------------------------------------------------------------------------
+# Pallas / JAX custom ops
+# ---------------------------------------------------------------------------
+
+_CUSTOM_OPS = {}
+
+
+def register_op(name: str, fn: Callable = None, *,
+                grad_fn: Optional[Callable] = None,
+                num_diff_args: Optional[int] = None,
+                expose: bool = True):
+    """Register ``fn(*jax_arrays) -> jax_array(s)`` as op ``name``.
+
+    With ``grad_fn(res, grads) -> input_grads`` the op gets a hand-written
+    backward via jax.custom_vjp (``fn`` must then also return residuals:
+    it is wrapped so that forward output is ``fn``'s result and ``grad_fn``
+    receives ``(inputs, output)`` as residuals).  Without it, autodiff
+    differentiates through the implementation (works for Pallas kernels in
+    interpret mode and any jnp/lax composition).
+
+    Usable as a decorator::
+
+        @register_op("fused_gelu")
+        def fused_gelu(x):  # raw jax arrays
+            return 0.5 * x * (1 + jax.lax.erf(x / 2**0.5))
+
+    After registration: ``paddle_tpu.ops.fused_gelu`` (Tensor-level, tape
+    autograd) and ``paddle_tpu.ops.fused_gelu.raw`` (trace-level).
+    """
+    if fn is None:
+        return lambda f: register_op(name, f, grad_fn=grad_fn,
+                                     num_diff_args=num_diff_args,
+                                     expose=expose)
+    if not name.isidentifier():
+        raise ValueError(f"op name must be a Python identifier: {name!r}")
+
+    raw = fn
+    if grad_fn is not None:
+        argcount = fn.__code__.co_argcount
+        n = num_diff_args if num_diff_args is not None else argcount
+        # trailing args beyond num_diff_args are declared non-differentiable
+        # (the custom_vjp mechanism for attrs like scales/axes); grad_fn
+        # must return exactly n cotangents
+        nondiff = tuple(range(n, argcount))
+        _cvjp = jax.custom_vjp(fn, nondiff_argnums=nondiff)
+
+        def _fwd(*args):
+            out = fn(*args)
+            return out, (args, out)
+
+        def _bwd(*call_args):
+            # with nondiff_argnums, bwd receives (*nondiff_vals, res, g)
+            res, g = call_args[-2], call_args[-1]
+            grads = grad_fn(res, g)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != n:
+                raise ValueError(
+                    f"custom op {name!r}: grad_fn returned {len(grads)} "
+                    f"gradients for {n} differentiable inputs")
+            return tuple(grads)
+
+        _cvjp.defvjp(_fwd, _bwd)
+
+        @functools.wraps(fn)
+        def raw_cvjp(*args):
+            return _cvjp(*args)
+
+        raw = raw_cvjp
+
+    from ..core.dispatch import wrap_op
+    op = wrap_op(raw, name=name)
+    if expose:
+        from .. import ops as ops_module
+        # refuse to shadow a BUILT-IN op or module (re-registering one's own
+        # custom op under the same name is allowed)
+        if name not in _CUSTOM_OPS:
+            import paddle_tpu
+            if hasattr(ops_module, name) or hasattr(paddle_tpu, name):
+                raise ValueError(
+                    f"op {name!r} would shadow an existing paddle_tpu "
+                    "attribute; pick another name or use expose=False")
+        setattr(ops_module, name, op)
+        import paddle_tpu
+        setattr(paddle_tpu, name, op)
+    _CUSTOM_OPS[name] = op
+    return op
+
+
+def get_op(name: str):
+    """Look up a registered custom op (reference: OpInfoMap lookup)."""
+    try:
+        return _CUSTOM_OPS[name]
+    except KeyError:
+        raise KeyError(f"custom op {name!r} is not registered; "
+                       f"registered: {sorted(_CUSTOM_OPS)}") from None
+
+
+def registered_ops():
+    return sorted(_CUSTOM_OPS)
+
+
+# ---------------------------------------------------------------------------
+# C++ host extensions
+# ---------------------------------------------------------------------------
+
+class CppExtension:
+    """Build spec for C++ sources (reference: cpp_extension.py CppExtension).
+    In the TPU build this is consumed by :func:`load`/:func:`setup`."""
+
+    def __init__(self, sources: Sequence[str], extra_compile_args=None,
+                 extra_link_args=None, include_dirs=None, name=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+        self.name = name
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension has no meaning on TPU — device kernels are Pallas "
+        "(see paddle_tpu.utils.cpp_extension.register_op); host-side C++ "
+        "uses CppExtension/load.")
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose: bool = False):
+    """JIT-compile C++ sources to a shared library and return the ctypes
+    CDLL (reference: cpp_extension.load, which JIT-builds and imports the
+    op library; custom_operator.cc load_op_library)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    stamp = os.path.join(build_dir, f"{name}.stamp")
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not (os.path.exists(so_path) and os.path.exists(stamp)
+            and os.path.getmtime(stamp) >= newest_src):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               "-o", so_path] + srcs
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += list(extra_cxx_cflags or [])
+        cmd += list(extra_ldflags or [])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"building extension {name!r} failed:\n{proc.stderr}")
+        with open(stamp, "w") as f:
+            f.write(str(newest_src))
+    return ctypes.CDLL(so_path)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """setuptools-style entry (reference: cpp_extension.setup).  Builds each
+    CppExtension immediately and returns the loaded libraries keyed by
+    extension name (no pip machinery in the TPU build)."""
+    out = {}
+    for ext in (ext_modules or []):
+        ext_name = ext.name or name
+        out[ext_name] = load(ext_name, ext.sources,
+                             extra_cxx_cflags=ext.extra_compile_args,
+                             extra_ldflags=ext.extra_link_args,
+                             extra_include_paths=ext.include_dirs)
+    return out

@@ -1,0 +1,137 @@
+"""Custom-op / extension mechanism tests (reference analogue:
+python/paddle/fluid/tests/custom_op/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import (CppExtension, get_op, load,
+                                            register_op, registered_ops)
+
+
+def test_register_op_forward_and_autodiff():
+    import jax
+
+    @register_op("my_gelu")
+    def my_gelu(x):
+        return 0.5 * x * (1 + jax.lax.erf(x / 2 ** 0.5))
+
+    x = paddle.to_tensor(np.linspace(-2, 2, 8, dtype=np.float32))
+    x.stop_gradient = False
+    # exposed on both namespaces
+    y = paddle.ops.my_gelu(x)
+    y2 = paddle.my_gelu(x)
+    np.testing.assert_allclose(y.numpy(), y2.numpy())
+    # tape autograd flows through the registered op
+    y.sum().backward()
+    assert x.grad is not None
+    g = x.grad.numpy()
+    # numeric check at 0: gelu'(0) = 0.5
+    mid = g[len(g) // 2 - 1:len(g) // 2 + 1].mean()
+    assert abs(mid - 0.5) < 0.1
+    assert "my_gelu" in registered_ops()
+    assert get_op("my_gelu") is paddle.ops.my_gelu
+
+
+def test_register_op_custom_grad():
+    """grad_fn overrides autodiff (the custom_vjp path)."""
+    import jax.numpy as jnp
+
+    def double_grad(res, g):
+        (x,), _ = res
+        return (2.0 * g * jnp.ones_like(x),)   # pretend d/dx = 2
+
+    @register_op("fake_identity", grad_fn=double_grad)
+    def fake_identity(x):
+        return x * 1.0
+
+    x = paddle.to_tensor([3.0, 4.0])
+    x.stop_gradient = False
+    y = get_op("fake_identity")(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_register_op_custom_grad_nondiff_args():
+    """num_diff_args marks trailing args non-differentiable."""
+    import jax.numpy as jnp
+
+    def gfn(res, g):
+        (x, s), _ = res
+        return (g * s,)
+
+    @register_op("scale_by", grad_fn=gfn, num_diff_args=1, expose=False)
+    def scale_by(x, s):
+        return x * s
+
+    x = paddle.to_tensor([1.0, 2.0])
+    x.stop_gradient = False
+    y = get_op("scale_by")(x, 3.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_register_op_refuses_builtin_shadow():
+    with pytest.raises(ValueError):
+        register_op("concat", lambda x: x)
+
+
+def test_register_op_usable_in_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @register_op("scaled_square", expose=False)
+    def scaled_square(x, s):
+        return s * x * x
+
+    op = get_op("scaled_square")
+    f = jax.jit(lambda a: op.raw(a, 3.0))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray([2.0]))), [12.0])
+
+
+def test_register_op_pallas_interpret():
+    """A Pallas kernel registered as an op (interpret mode on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def add_one_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    @register_op("pallas_add_one", expose=False)
+    def pallas_add_one(x):
+        return pl.pallas_call(
+            add_one_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    x = paddle.to_tensor(np.zeros((8, 128), np.float32))
+    y = get_op("pallas_add_one")(x)
+    np.testing.assert_allclose(y.numpy(), np.ones((8, 128), np.float32))
+
+
+def test_bad_name_rejected():
+    with pytest.raises(ValueError):
+        register_op("not-an-identifier", lambda x: x)
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "myext.cpp"
+    src.write_text("""
+        extern "C" long long triple(long long v) { return 3 * v; }
+    """)
+    lib = load("myext", [str(src)], build_directory=str(tmp_path))
+    import ctypes
+    lib.triple.restype = ctypes.c_longlong
+    lib.triple.argtypes = [ctypes.c_longlong]
+    assert lib.triple(14) == 42
+    # cached rebuild path (stamp newer than source): loads without compiling
+    lib2 = load("myext", [str(src)], build_directory=str(tmp_path))
+    assert lib2.triple(1) == 3
+
+
+def test_cpp_extension_setup(tmp_path):
+    src = tmp_path / "ext2.cpp"
+    src.write_text('extern "C" int five() { return 5; }')
+    from paddle_tpu.utils.cpp_extension import setup
+    libs = setup(ext_modules=[CppExtension([str(src)], name="ext2")])
+    assert libs["ext2"].five() == 5

@@ -1,0 +1,95 @@
+"""paddle.text datasets + viterbi_decode tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_text_datasets_shapes():
+    from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                                 UCIHousing, WMT14, WMT16)
+    imdb = Imdb(mode="train", synthetic_size=16)
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label in (0, 1) and len(imdb) == 16
+
+    ngram = Imikolov(mode="test", window_size=5, synthetic_size=8)
+    ctx, nxt = ngram[0]
+    assert len(ctx) == 4 and isinstance(nxt, np.int64)
+
+    ml = Movielens(synthetic_size=8)
+    rec = ml[0]
+    assert len(rec) == 8 and rec[-1] >= 1.0
+
+    uci = UCIHousing(mode="train", synthetic_size=8)
+    feat, price = uci[0]
+    assert feat.shape == (13,) and price.shape == (1,)
+
+    srl = Conll05st(synthetic_size=8)
+    words, pred, labels = srl[0]
+    assert len(words) == len(pred) == len(labels)
+
+    for ds_cls in (WMT14, WMT16):
+        ds = ds_cls(mode="train", synthetic_size=8)
+        src, trg, trg_next = ds[0]
+        assert trg[0] == ds.BOS and trg_next[-1] == ds.EOS
+        assert len(trg) == len(trg_next)
+
+
+def _brute_viterbi(pots, trans, start, stop):
+    t, n = pots.shape
+    import itertools
+    best, best_path = -1e30, None
+    for path in itertools.product(range(n), repeat=t):
+        s = start[path[0]] + pots[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + pots[i, path[i]]
+        s += stop[path[-1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    # reference layout: trans is (N, N) with the SAME N as potentials;
+    # the last two tags are the virtual BOS/EOS tags
+    b, t, n = 3, 4, 5
+    pots = rng.randn(b, t, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        include_bos_eos_tag=True)
+    start, stop = trans[-2, :], trans[:, -1]
+    for i in range(b):
+        want_score, want_path = _brute_viterbi(pots[i], trans, start, stop)
+        np.testing.assert_allclose(float(scores.numpy()[i]), want_score,
+                                   rtol=1e-4)
+        assert list(paths.numpy()[i]) == want_path
+    # mismatched transition shape is rejected, not misdecoded
+    import pytest
+    with pytest.raises(ValueError):
+        paddle.text.viterbi_decode(
+            paddle.to_tensor(pots),
+            paddle.to_tensor(rng.randn(n + 2, n + 2).astype(np.float32)))
+
+
+def test_viterbi_decoder_layer_and_lengths():
+    rng = np.random.RandomState(1)
+    pots = rng.randn(2, 5, 4).astype(np.float32)
+    trans = rng.randn(4, 4).astype(np.float32)
+    dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans),
+                                     include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pots),
+                        lengths=paddle.to_tensor(np.array([5, 3])))
+    assert tuple(paths.shape) == (2, 5)
+    # seq 0 (full length) must match brute force with zero start/stop
+    want_score, want_path = _brute_viterbi(
+        pots[0], trans, np.zeros(4, np.float32), np.zeros(4, np.float32))
+    np.testing.assert_allclose(float(scores.numpy()[0]), want_score,
+                               rtol=1e-4)
+    assert list(paths.numpy()[0]) == want_path
+    # seq 1: only the first 3 positions matter
+    want_score1, want_path1 = _brute_viterbi(
+        pots[1, :3], trans, np.zeros(4, np.float32), np.zeros(4, np.float32))
+    np.testing.assert_allclose(float(scores.numpy()[1]), want_score1,
+                               rtol=1e-4)
+    assert list(paths.numpy()[1][:3]) == want_path1
