@@ -60,6 +60,7 @@ from . import profiler      # noqa: E402
 from . import hapi          # noqa: E402
 from .hapi import Model     # noqa: E402
 from .framework import load, save  # noqa: E402
+from .utils.flags import get_flags, set_flags  # noqa: E402
 from .nn import DataParallel  # noqa: E402
 from .device import get_device, set_device  # noqa: E402
 from .jit import to_static  # noqa: E402

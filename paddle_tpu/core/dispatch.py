@@ -24,6 +24,7 @@ from .tensor import GradNode, Tensor
 
 _TensorLeaf = lambda x: isinstance(x, Tensor)
 _amp = None  # lazily bound paddle_tpu.amp module
+_flags_registry = None  # lazily bound utils.flags._REGISTRY
 
 
 def _is_diff(x) -> bool:
@@ -53,7 +54,7 @@ def call(raw_fn: Callable, *args, name: str = None, **kwargs):
     if not diff_idx:
         a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
         out = raw_fn(*a2, **k2)
-        return _wrap_outputs(out, None)
+        return _wrap_outputs(out, None, op_name=name)
 
     diff_arrays = [arrays[i] for i in diff_idx]
 
@@ -74,11 +75,12 @@ def call(raw_fn: Callable, *args, name: str = None, **kwargs):
         name=name or getattr(raw_fn, "__name__", "op"),
         out_treedef=out_treedef,
     )
-    return _wrap_outputs(out, node)
+    return _wrap_outputs(out, node, op_name=name)
 
 
-def _wrap_outputs(out, node):
+def _wrap_outputs(out, node, op_name=None):
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    _maybe_check_nan_inf(out_leaves, op_name)
     wrapped = []
     for i, o in enumerate(out_leaves):
         t = Tensor(o, stop_gradient=True)
@@ -89,6 +91,34 @@ def _wrap_outputs(out, node):
             t._stop_gradient = False
         wrapped.append(t)
     return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def _maybe_check_nan_inf(out_leaves, op_name):
+    """FLAGS_check_nan_inf: validate every eager op output is finite
+    (reference: operator.cc:1252 -> nan_inf_utils_detail CheckVarHasNanOrInf
+    — per-op attribution of the first non-finite value).  Eager arrays only;
+    traced values are covered by jax debug_nans."""
+    global _flags_registry
+    if _flags_registry is None:
+        from ..utils import flags as _flags_mod
+        _flags_registry = _flags_mod._REGISTRY
+    # direct registry read: this gate sits on EVERY eager op dispatch
+    if not _flags_registry.get("check_nan_inf"):
+        return
+    for o in out_leaves:
+        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+            continue
+        if not _dtype_mod.is_inexact(o.dtype):
+            continue
+        finite = bool(jnp.all(jnp.isfinite(o)))
+        if not finite:
+            n_nan = int(jnp.sum(jnp.isnan(o)))
+            n_inf = int(jnp.sum(jnp.isinf(o)))
+            raise FloatingPointError(
+                f"Operator {op_name or '<unknown>'} output contains "
+                f"{n_nan} NaN / {n_inf} Inf values "
+                f"(shape {tuple(o.shape)}, dtype {o.dtype}). "
+                "Set FLAGS_check_nan_inf=0 to disable this check.")
 
 
 def wrap_op(raw_fn: Callable = None, *, name: str = None):

@@ -16,10 +16,11 @@ from .collective import (ReduceOp, all_gather, all_gather_object, all_reduce,
                          broadcast, get_group, irecv, isend, new_group, recv,
                          reduce, reduce_scatter, scatter, send, wait)
 from .mesh import (CommunicateTopology, HybridCommunicateGroup, get_mesh,
-                   init_mesh, named_sharding, set_mesh)
+                   init_hybrid_mesh, init_mesh, named_sharding, set_mesh)
 from .parallel_base import (DataParallel, ParallelEnv, get_rank,
                             get_world_size, init_parallel_env, parallelize,
                             shard_tensor, shard_dataloader)
+from . import auto_parallel
 from . import fleet
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import moe, mp_layers, pipeline, ring_attention

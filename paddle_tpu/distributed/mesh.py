@@ -40,6 +40,55 @@ def init_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return _global_mesh
 
 
+def init_hybrid_mesh(dcn_axes: Dict[str, int], ici_axes: Dict[str, int],
+                     devices=None) -> Mesh:
+    """Multi-slice mesh: ``dcn_axes`` span slices over the data-center
+    network, ``ici_axes`` stay within a slice's ICI fabric.
+
+    The analogue of the reference's FleetExecutor cross-cluster pipelining
+    (fleet_executor/, SURVEY.md N25) and ProcessGroupHeter's
+    intra-NCCL/inter-RPC split (ProcessGroupHeter.h:64): communication-heavy
+    axes (tensor/sequence/expert parallel) are laid out on ICI; only the
+    bandwidth-light axes (data/pipeline) cross DCN.  Built with
+    jax.experimental.mesh_utils.create_hybrid_device_mesh so the device
+    order matches the physical slice topology; falls back to a plain mesh
+    when all devices are one slice (CPU tests, single slice)."""
+    global _global_mesh
+    devices = devices if devices is not None else jax.devices()
+    dcn_names = [a for a in AXIS_ORDER if a in dcn_axes] + \
+        [a for a in dcn_axes if a not in AXIS_ORDER]
+    ici_names = [a for a in AXIS_ORDER if a in ici_axes] + \
+        [a for a in ici_axes if a not in AXIS_ORDER]
+    overlap = set(dcn_names) & set(ici_names)
+    if overlap:
+        raise ValueError(f"axes cannot be both DCN and ICI: {sorted(overlap)}")
+    dcn_shape = [dcn_axes[a] for a in dcn_names]
+    ici_shape = [ici_axes[a] for a in ici_names]
+    names = tuple(dcn_names + ici_names)
+    sizes = dcn_shape + ici_shape
+    # mesh_utils needs per-device slice topology (slice_index); CPU/mock
+    # devices don't have it — those take the row-major fallback below
+    has_slices = all(getattr(d, "slice_index", None) is not None
+                     for d in devices)
+    if has_slices:
+        from jax.experimental import mesh_utils
+        # contract: mesh_shape and dcn_mesh_shape must be the SAME rank;
+        # pad each side with 1s so the result's shape is dcn_shape+ici_shape
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[1] * len(dcn_shape) + ici_shape,
+            dcn_mesh_shape=dcn_shape + [1] * len(ici_shape),
+            devices=devices)
+        _global_mesh = Mesh(dev_array.reshape(sizes), names)
+    else:
+        # single-slice / CPU-mesh fallback: row-major assignment with the
+        # DCN axes outermost (they change slowest -> contiguous slices)
+        n = int(np.prod(sizes)) if sizes else 1
+        if n > len(devices):
+            raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+        _global_mesh = Mesh(np.asarray(devices[:n]).reshape(sizes), names)
+    return _global_mesh
+
+
 def get_mesh() -> Optional[Mesh]:
     return _global_mesh
 

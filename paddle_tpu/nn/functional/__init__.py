@@ -5,7 +5,7 @@ from .common import (alpha_dropout, bilinear, channel_shuffle,
                      cosine_similarity, dropout, dropout2d, dropout3d,
                      embedding, fold, interpolate, label_smooth, linear,
                      normalize, one_hot, pad, pixel_shuffle, pixel_unshuffle,
-                     unfold, upsample)
+                     sequence_mask, unfold, upsample)
 from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
                    conv3d_transpose)
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,

@@ -259,3 +259,17 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
             out = out.at[:, :, hi:hi + lh * st[0]:st[0],
                          wj:wj + lw * st[1]:st[1]].add(cols[:, :, i, j])
     return out[:, :, pd[0]:pd[0] + oh, pd[1]:pd[1] + ow]
+
+
+@wrap_op
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """reference: paddle.nn.functional.sequence_mask
+    (operators/sequence_ops/sequence_mask_op.*): mask[i, ..., j] = j < x[i].
+    ``maxlen=None`` uses max(x) — a data-dependent shape, so inside jit
+    pass an explicit maxlen (static shapes under XLA)."""
+    from ...core.dtype import convert_dtype
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    steps = jnp.arange(int(maxlen))
+    mask = steps < jnp.expand_dims(x, -1)
+    return mask.astype(convert_dtype(dtype))

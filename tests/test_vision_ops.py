@@ -1,0 +1,206 @@
+"""paddle.vision.ops detection operator tests (reference analogue:
+test_yolo_box_op.py, test_roi_align_op.py, test_roi_pool_op.py,
+test_psroi_pool_op.py, test_nms_op.py, test_deform_conv2d.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def test_nms_basic():
+    boxes = np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],     # heavy overlap with box 0
+        [50, 50, 60, 60],   # disjoint
+        [0, 0, 5, 5],       # IoU with box0 = 25/100 = 0.25
+    ], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    kept = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores))
+    assert list(kept.numpy()) == [0, 2, 3]
+    # lower threshold also suppresses the 0.25-IoU box
+    kept = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.2,
+                    scores=paddle.to_tensor(scores))
+    assert list(kept.numpy()) == [0, 2]
+
+
+def test_nms_categories_and_topk():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int32)
+    kept = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats), categories=[0, 1])
+    assert list(kept.numpy()) == [0, 1]   # different categories: both kept
+    kept = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats), categories=[0, 1],
+                    top_k=1)
+    assert list(kept.numpy()) == [0]
+
+
+def test_roi_align_constant_map():
+    """On a constant feature map every aligned bin averages to the
+    constant."""
+    x = np.full((1, 3, 16, 16), 7.0, np.float32)
+    boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=4)
+    assert tuple(out.shape) == (1, 3, 4, 4)
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-5)
+
+
+def test_roi_align_linear_gradient_map():
+    """Linear ramp f(y,x)=x: aligned bin centers must reproduce the ramp."""
+    w = 16
+    x = np.tile(np.arange(w, dtype=np.float32), (1, 1, w, 1))
+    boxes = np.array([[4.0, 4.0, 12.0, 12.0]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2, sampling_ratio=2).numpy()[0, 0]
+    # bin centers at x = 4 + {2, 6} -> averages 6 and 10 (aligned=True
+    # shifts by 0.5: samples at 5.5,6.5 / 9.5,10.5 minus half-pixel = 6, 10)
+    np.testing.assert_allclose(out[0], [6.0 - 0.5, 10.0 - 0.5], atol=1e-4)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 6, 6] = 9.0
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], np.float32)
+    out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        output_size=2).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 5.0     # top-left bin contains (2,2)
+    assert out[0, 0, 1, 1] == 9.0     # bottom-right bin contains (6,6)
+
+
+def test_psroi_pool_position_sensitive():
+    ph = pw = 2
+    out_c = 1
+    x = np.zeros((1, out_c * ph * pw, 4, 4), np.float32)
+    # channel k = i*pw + j holds value 10*k everywhere
+    for k in range(ph * pw):
+        x[0, k] = 10.0 * k
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = vops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          output_size=2).numpy()
+    # bin (i, j) reads channel i*pw+j -> value 10*(i*pw+j)
+    want = np.array([[[0.0, 10.0], [20.0, 30.0]]], np.float32)[None]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_roi_batch_assignment():
+    """boxes_num routes rois to the right images."""
+    x = np.stack([np.full((1, 4, 4), 1.0, np.float32),
+                  np.full((1, 4, 4), 2.0, np.float32)])
+    boxes = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1, 1], np.int32)),
+                         output_size=1).numpy()
+    np.testing.assert_allclose(out[:, 0, 0, 0], [1.0, 2.0], rtol=1e-5)
+
+
+def test_yolo_box_shapes_and_range():
+    n, an, k, h = 2, 3, 5, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = np.random.RandomState(0).randn(n, an * (5 + k), h, h).astype(
+        np.float32)
+    img = np.full((n, 2), 128, np.int32)
+    boxes, scores = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                  anchors, k, conf_thresh=0.0,
+                                  downsample_ratio=32)
+    assert tuple(boxes.shape) == (n, an * h * h, 4)
+    assert tuple(scores.shape) == (n, an * h * h, k)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 127).all()     # clipped to image
+    s = scores.numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_yolo_box_center_formula():
+    """One anchor, zero logits: box center must sit at the cell center."""
+    k = 1
+    x = np.zeros((1, 1 * (5 + k), 2, 2), np.float32)
+    img = np.array([[64, 64]], np.int32)
+    boxes, _ = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                             [32, 32], k, conf_thresh=-1.0,
+                             downsample_ratio=32, clip_bbox=False)
+    b = boxes.numpy().reshape(1, 1, 2, 2, 4)
+    # cell (0,0): center = (0.5/2, 0.5/2) * 64 = 16; w = h = 32/64*64 = 32
+    np.testing.assert_allclose(b[0, 0, 0, 0], [0.0, 0.0, 32.0, 32.0],
+                               atol=1e-4)
+
+
+def test_yolo_loss_decreases_on_matching_prediction():
+    """Loss with a correctly-placed prediction < loss with a wrong one."""
+    rng = np.random.RandomState(0)
+    n, an, k, h = 1, 3, 2, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    gt_box = np.array([[[0.5, 0.5, 0.2, 0.2]]], np.float32)
+    gt_label = np.array([[1]], np.int64)
+
+    def loss_for(obj_logit):
+        x = np.zeros((n, an * (5 + k), h, h), np.float32)
+        xr = x.reshape(n, an, 5 + k, h, h)
+        xr[:, :, 4] = -6.0                      # background everywhere
+        # best wh-IoU anchor for a 0.2x0.2 gt among these anchors is the
+        # first; objectness at the gt's cell (2,2)
+        xr[:, 0, 4, 2, 2] = obj_logit
+        return float(vops.yolo_loss(
+            paddle.to_tensor(xr.reshape(n, -1, h, h)),
+            paddle.to_tensor(gt_box), paddle.to_tensor(gt_label),
+            anchors, [0, 1, 2], k, ignore_thresh=0.7,
+            downsample_ratio=8).numpy()[0])
+
+    assert loss_for(6.0) < loss_for(-6.0)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets, deform_conv2d == plain conv2d."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    wgt = rng.randn(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 3 * 3, 4, 4), np.float32)
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                             paddle.to_tensor(wgt)).numpy()
+    import paddle_tpu.nn.functional as F
+    want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wgt)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_scales():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    wgt = rng.randn(2, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 3, 3), np.float32)
+    mask_half = np.full((1, 9, 3, 3), 0.5, np.float32)
+    full = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                              paddle.to_tensor(wgt)).numpy()
+    half = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                              paddle.to_tensor(wgt),
+                              mask=paddle.to_tensor(mask_half)).numpy()
+    np.testing.assert_allclose(half, 0.5 * full, rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv2d_layer_and_conv_norm_activation():
+    layer = vops.DeformConv2D(2, 4, 3)
+    x = paddle.randn([1, 2, 6, 6])
+    offset = paddle.zeros([1, 18, 4, 4])
+    out = layer(x, offset)
+    assert tuple(out.shape) == (1, 4, 4, 4)
+    cna = vops.ConvNormActivation(3, 8, kernel_size=3)
+    out = cna(paddle.randn([2, 3, 8, 8]))
+    assert tuple(out.shape) == (2, 8, 8, 8)
+    assert float(out.numpy().min()) >= 0.0    # ReLU applied
+
+
+def test_read_file(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(16)))
+    t = vops.read_file(str(p))
+    np.testing.assert_array_equal(t.numpy(), np.arange(16, dtype=np.uint8))
