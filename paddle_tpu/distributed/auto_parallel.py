@@ -32,16 +32,40 @@ class ProcessMesh:
     def __init__(self, mesh=None, dim_names=None, shape=None,
                  process_ids=None):
         import numpy as np
+        devices = None
         if mesh is not None and dim_names is not None:
             arr = np.asarray(mesh)
             axes = {name: dim for name, dim in zip(dim_names, arr.shape)}
+            # honor the explicit rank->coordinate assignment: order the
+            # jax devices by the given ids (reference: process_mesh.py mesh
+            # content IS the rank layout)
+            all_devs = {d.id: d for d in __import__("jax").devices()}
+            try:
+                devices = [all_devs[int(i)] for i in arr.flatten()]
+            except KeyError as e:
+                raise ValueError(
+                    f"ProcessMesh refers to unknown device id {e}") from None
         elif shape is not None and dim_names is not None:
             axes = {name: dim for name, dim in zip(dim_names, shape)}
         else:
             raise ValueError("ProcessMesh needs (mesh|shape) + dim_names")
+        if process_ids is not None:
+            raise NotImplementedError(
+                "ProcessMesh(process_ids=...) is not supported in the TPU "
+                "build — pass the ids as the `mesh` array instead")
         self.dim_names = list(dim_names)
         self.shape = [axes[n] for n in self.dim_names]
-        self._jax_mesh = _mesh.init_mesh(axes)
+        # build the Mesh directly: the user's dim order and device layout
+        # are honored verbatim (init_mesh would reorder to AXIS_ORDER)
+        import numpy as np
+        from jax.sharding import Mesh
+        import jax as _jax
+        if devices is None:
+            n = int(np.prod(self.shape))
+            devices = _jax.devices()[:n]
+        self._jax_mesh = Mesh(
+            np.asarray(devices).reshape(self.shape), tuple(self.dim_names))
+        _mesh.set_mesh(self._jax_mesh)
 
     @property
     def mesh(self):
@@ -61,12 +85,19 @@ def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
     def constrained(*args, **kwargs):
         from jax.sharding import NamedSharding
 
+        from ..core.dispatch import call
+
         def put(v, spec):
             if spec is None:
                 return v
             s = NamedSharding(mesh, PartitionSpec(*spec))
             if isinstance(v, Tensor):
-                return Tensor(jax.lax.with_sharding_constraint(v._array, s))
+                # through the dispatch layer so the tape records the
+                # (identity-pullback) constraint — a bare Tensor() rebuild
+                # would sever autograd for eager inputs
+                return call(
+                    lambda a: jax.lax.with_sharding_constraint(a, s), v,
+                    name="shard_op_constraint")
             return jax.lax.with_sharding_constraint(v, s)
 
         if in_shard_specs is not None:
@@ -171,6 +202,7 @@ class Engine:
 
     def evaluate(self, valid_data, batch_size=64, steps=None, verbose=0):
         loader = self._to_loader(valid_data, batch_size, shuffle=False)
+        was_training = getattr(self.model, "training", True)
         self.model.eval()
         for m in self.metrics:
             m.reset()
@@ -186,7 +218,8 @@ class Engine:
             count += 1
             for m in self.metrics:
                 m.update(m.compute(out, *parts[ni:]))
-        self.model.train()
+        if was_training:
+            self.model.train()
         result = {"loss": total / max(count, 1)}
         for m in self.metrics:
             result[m.name() if callable(getattr(m, "name", None))
@@ -195,6 +228,7 @@ class Engine:
 
     def predict(self, test_data, batch_size=64, steps=None, verbose=0):
         loader = self._to_loader(test_data, batch_size, shuffle=False)
+        was_training = getattr(self.model, "training", True)
         self.model.eval()
         outs = []
         for i, batch in enumerate(loader):
@@ -203,7 +237,8 @@ class Engine:
             parts = self._flatten(batch)
             outs.append(self.model(
                 *parts[:getattr(self, "_num_inputs", 1)]))
-        self.model.train()
+        if was_training:
+            self.model.train()
         return outs
 
     # -- introspection ------------------------------------------------------

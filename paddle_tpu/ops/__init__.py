@@ -13,6 +13,7 @@ from .search import *  # noqa: F401,F403
 from .random_ops import (bernoulli, binomial, gaussian, multinomial, normal,
                          poisson, rand, randint, randint_like, randn, randperm,
                          standard_normal, uniform)
+from .extras import *  # noqa: F401,F403
 from . import methods as _methods
 
 _methods.install()

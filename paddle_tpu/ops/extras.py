@@ -1,0 +1,205 @@
+"""Long-tail surface parity ops (reference: the remaining module-level
+symbols of python/paddle/tensor/__init__.py — in-place function forms,
+TensorArray helpers for static control flow, dtype predicates, printing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import wrap_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add_n", "diagonal", "logit", "renorm", "lu_unpack", "broadcast_shape",
+    "rank", "is_complex", "is_floating_point", "is_integer", "tolist",
+    "set_printoptions", "check_shape", "create_array", "array_write",
+    "array_read", "array_length",
+    # module-level in-place forms (delegate to the Tensor methods)
+    "add_", "subtract_", "clip_", "ceil_", "exp_", "floor_", "reciprocal_",
+    "round_", "rsqrt_", "sqrt_", "scale_", "tanh_", "erfinv_", "lerp_",
+    "reshape_", "flatten_", "squeeze_", "unsqueeze_", "scatter_",
+    "put_along_axis_", "uniform_", "exponential_",
+]
+
+
+@wrap_op
+def add_n(inputs):
+    """reference: paddle.add_n — elementwise sum of a tensor list."""
+    total = inputs[0]
+    for x in inputs[1:]:
+        total = total + x
+    return total
+
+
+@wrap_op
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@wrap_op
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@wrap_op
+def renorm(x, p, axis, max_norm):
+    """Per-slice p-norm clamp along ``axis`` (reference: paddle.renorm)."""
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12),
+                      1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+@wrap_op
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack the packed LU factorization (reference: paddle.lu_unpack):
+    x = packed LU (…, M, N), y = pivots (…, K).  Returns (P, L, U)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    lower = jnp.tril(x[..., :, :k], -1) + \
+        jnp.eye(m, k, dtype=x.dtype)
+    upper = jnp.triu(x[..., :k, :])
+    # pivots (1-based sequential row swaps) -> permutation matrix
+    piv = y.astype(jnp.int32) - 1
+
+    def perm_of(pv):
+        def body(i, perm):
+            j = pv[i]
+            pi = perm[i]
+            pj = perm[j]
+            perm = perm.at[i].set(pj)
+            return perm.at[j].set(pi)
+        return jax.lax.fori_loop(0, pv.shape[0], body, jnp.arange(m))
+
+    if piv.ndim == 1:
+        perm = perm_of(piv)
+        p = jnp.eye(m, dtype=x.dtype)[perm].T
+    else:
+        batch = piv.reshape(-1, piv.shape[-1])
+        perms = jax.vmap(perm_of)(batch)
+        p = jnp.eye(m, dtype=x.dtype)[perms]
+        p = jnp.swapaxes(p, -1, -2).reshape(x.shape[:-2] + (m, m))
+    return p, lower, upper
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x._array.ndim if isinstance(x, Tensor)
+                              else jnp.asarray(x).ndim, jnp.int32))
+
+
+def is_complex(x):
+    d = x._array.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    d = x._array.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(x):
+    d = x._array.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def tolist(x):
+    return np.asarray(x._array if isinstance(x, Tensor) else x).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — numpy printing drives repr."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """reference: tensor/creation.py check_shape — validate a shape arg."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and s is not None:
+            raise TypeError(f"shape entries must be ints, got {type(s)}")
+        if s is not None and int(s) < -1:
+            raise ValueError(f"shape entries must be >= -1, got {s}")
+
+
+# -- TensorArray (reference: fluid LoDTensorArray + paddle.tensor.array_*;
+# under trace these are the write/read ops of static control flow — here a
+# plain Python list works both eagerly and as a scan carrier) -------------
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(i)
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+# -- module-level in-place forms --------------------------------------------
+
+def _mk_inplace(method_name):
+    def fn(x, *args, **kwargs):
+        return getattr(x, method_name)(*args, **kwargs)
+    fn.__name__ = method_name
+    fn.__doc__ = f"Module-level form of Tensor.{method_name} (in-place)."
+    return fn
+
+
+add_ = _mk_inplace("add_")
+subtract_ = _mk_inplace("subtract_")
+clip_ = _mk_inplace("clip_")
+ceil_ = _mk_inplace("ceil_")
+exp_ = _mk_inplace("exp_")
+floor_ = _mk_inplace("floor_")
+reciprocal_ = _mk_inplace("reciprocal_")
+round_ = _mk_inplace("round_")
+rsqrt_ = _mk_inplace("rsqrt_")
+sqrt_ = _mk_inplace("sqrt_")
+scale_ = _mk_inplace("scale_")
+tanh_ = _mk_inplace("tanh_")
+erfinv_ = _mk_inplace("erfinv_")
+lerp_ = _mk_inplace("lerp_")
+reshape_ = _mk_inplace("reshape_")
+flatten_ = _mk_inplace("flatten_")
+squeeze_ = _mk_inplace("squeeze_")
+unsqueeze_ = _mk_inplace("unsqueeze_")
+scatter_ = _mk_inplace("scatter_")
+put_along_axis_ = _mk_inplace("put_along_axis_")
+uniform_ = _mk_inplace("uniform_")
+exponential_ = _mk_inplace("exponential_")

@@ -107,6 +107,27 @@ def install():
     T.zero_ = lambda self: _inplace(self, creation.zeros_like)
     T.fill_ = lambda self, v: _inplace(self, creation.full_like, v)
     T.exp_ = lambda self: _inplace(self, math.exp)
+    T.ceil_ = lambda self: _inplace(self, math.ceil)
+    T.floor_ = lambda self: _inplace(self, math.floor)
+    T.reciprocal_ = lambda self: _inplace(self, math.reciprocal)
+    T.round_ = lambda self: _inplace(self, math.round)
+    T.rsqrt_ = lambda self: _inplace(self, math.rsqrt)
+    T.sqrt_ = lambda self: _inplace(self, math.sqrt)
+    T.tanh_ = lambda self: _inplace(self, math.tanh)
+    T.erfinv_ = lambda self: _inplace(self, math.erfinv)
+    T.lerp_ = lambda self, y, weight: _inplace(self, math.lerp, y, weight)
+    T.flatten_ = lambda self, start_axis=0, stop_axis=-1: _inplace(
+        self, manipulation.flatten, start_axis, stop_axis)
+    T.squeeze_ = lambda self, axis=None: _inplace(
+        self, manipulation.squeeze, axis)
+    T.unsqueeze_ = lambda self, axis: _inplace(
+        self, manipulation.unsqueeze, axis)
+    T.scatter_ = lambda self, index, updates, overwrite=True: _inplace(
+        self, manipulation.scatter, index, updates, overwrite)
+    T.put_along_axis_ = lambda self, indices, values, axis, reduce="assign": \
+        _inplace(self, manipulation.put_along_axis, indices, values, axis,
+                 reduce)
+    T.exponential_ = lambda self, lam=1.0: random_ops.exponential_(self, lam)
     T.uniform_ = lambda self, min=-1.0, max=1.0, seed=0: _assign(
         self, random_ops.uniform(self.shape, self.dtype, min, max, seed))
     T.normal_ = lambda self, mean=0.0, std=1.0: _assign(

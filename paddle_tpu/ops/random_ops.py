@@ -105,9 +105,12 @@ def multinomial(x, num_samples=1, replacement=False):
 
 
 def exponential_(x, lam=1.0):
-    arr = jax.random.exponential(_rnd.next_key(), tuple(x.shape), x._array.dtype) / lam
-    x._array = arr
-    return x
+    arr = jax.random.exponential(_rnd.next_key(), tuple(x.shape),
+                                 x._array.dtype) / lam
+    # redirect through assign_inplace so a stale grad node never survives
+    # the overwrite (the value no longer depends on x's history)
+    from ..core.dispatch import assign_inplace
+    return assign_inplace(x, Tensor(arr))
 
 
 def binomial(count, prob):
