@@ -44,6 +44,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
+    # activation recompute per block (jax.checkpoint): trades ~1/3 more
+    # FLOPs for O(sqrt)-ish activation memory — required for long-sequence
+    # training (s=8192 without it sits at the 16GB HBM edge on one v5e)
+    use_recompute: bool = False
 
     @classmethod
     def gpt2_small(cls):
@@ -188,6 +192,9 @@ class GPTModel(Layer):
             if cache is not None:
                 x, ci = block(x, cache[i])
                 new_caches.append(ci)
+            elif self.config.use_recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(block, x)
             else:
                 x = block(x)
         x = self.ln_f(x)

@@ -81,7 +81,10 @@ def save_inference_model(path_prefix, feed_vars=None, fetch_vars=None,
         f.write(exported.mlir_module())
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump({k: np.asarray(v) for k, v in state.items()}, f)
+    feed_names = [getattr(s, "name", None) or f"x{i}"
+                  for i, s in enumerate(input_spec or [])]
     meta = {"input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+            "feed_names": feed_names,
             "format_version": 1}
     with open(path_prefix + ".pdmodel.meta", "wb") as f:
         pickle.dump(meta, f)
@@ -92,9 +95,10 @@ class _Predictor:
     """Executable predictor over a deserialized exported module (the
     AnalysisPredictor analogue, analysis_predictor.h:90/:132)."""
 
-    def __init__(self, fn, state):
+    def __init__(self, fn, state, feed_names=None):
         self._fn = fn
         self._state = state
+        self.feed_names = list(feed_names or [])
 
     @staticmethod
     def _unwrap_feeds(feeds):
@@ -115,7 +119,7 @@ def _wrap_out(out):
     return Tensor(out) if hasattr(out, "dtype") else out
 
 
-def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
+def load_inference_model(path_prefix, executor=None, model=None, **kwargs):
     """Load the exported artifact into an executable predictor.
 
     The serialized module is deserialized via ``jax.export`` and called
@@ -123,10 +127,24 @@ def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
     AnalysisPredictor loads and runs a ProgramDesc the same way,
     analysis_predictor.h:90).  Passing ``model`` re-traces through the live
     Layer instead (useful to re-lower for a new platform).
+
+    With ``executor`` (positionally second, matching static/io.py:681),
+    returns the reference triple ``[program, feed_names, fetch_targets]``
+    for ``exe.run(program, feed=..., fetch_list=...)``.
     """
+    # positional compat: a Layer in the executor slot means model=
+    from ..nn.layer.layers import Layer as _Layer
+    if isinstance(executor, _Layer) and model is None:
+        model, executor = executor, None
     with open(path_prefix + ".pdiparams", "rb") as f:
         state = pickle.load(f)
     state = {k: jnp.asarray(v) for k, v in state.items()}
+    try:
+        with open(path_prefix + ".pdmodel.meta", "rb") as f:
+            meta = pickle.load(f)
+        feed_names = list(meta.get("feed_names", []))
+    except OSError:
+        feed_names = []
     if model is not None:
         from ..jit import functional_call
         model.eval()
@@ -136,11 +154,18 @@ def load_inference_model(path_prefix, model=None, executor=None, **kwargs):
             out, _ = functional_call(model, state, *args)
             return out
 
-        return _Predictor(fwd, state)
-    from jax import export as jexport
-    with open(path_prefix + ".pdmodel", "rb") as f:
-        exported = jexport.deserialize(bytearray(f.read()))
-    return _Predictor(jax.jit(exported.call), state)
+        predictor = _Predictor(fwd, state, feed_names)
+    else:
+        from jax import export as jexport
+        with open(path_prefix + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(bytearray(f.read()))
+        predictor = _Predictor(jax.jit(exported.call), state, feed_names)
+    if executor is not None:
+        # reference triple contract (static/io.py:681): the caller does
+        # [prog, feeds, fetches] = load_inference_model(path, exe);
+        # exe.run(prog, feed={...}, fetch_list=fetches)
+        return [predictor, predictor.feed_names, ["__fetch__"]]
+    return predictor
 
 
 @contextlib.contextmanager
@@ -188,15 +213,38 @@ class CompiledProgram:
 
 
 class Executor:
-    """API-compat minimal executor: run(fn, feed, fetch) over jitted fns."""
+    """Minimal executor facade (reference: fluid/executor.py:619).
+
+    The TPU build has no ProgramDesc interpreter — the executable unit is a
+    loaded inference predictor (jax.export module).  ``run`` supports the
+    reference's load-and-run pattern::
+
+        exe = paddle.static.Executor()
+        prog, feed_names, fetches = paddle.static.load_inference_model(p, exe)
+        outs = exe.run(prog, feed={name: array}, fetch_list=fetches)
+    """
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if isinstance(program, _Predictor):
+            names = program.feed_names
+            if not names:
+                if feed and len(feed) > 1:
+                    # guessing an order here would silently permute inputs
+                    raise ValueError(
+                        "this artifact carries no feed-name metadata and "
+                        "the feed has multiple entries — re-export it with "
+                        "save_inference_model (names are recorded), or "
+                        "call the predictor positionally")
+                names = list(feed or {})
+            feeds = [feed[n] for n in names] if feed else []
+            outs = program.run(feeds)
+            return [np.asarray(o._array) for o in outs]
         raise NotImplementedError(
-            "The TPU build has no ProgramDesc interpreter; use "
-            "paddle_tpu.jit.to_static / TrainStep (SURVEY.md §7 table).")
+            "Executor.run executes loaded inference programs; for training "
+            "use paddle_tpu.jit.to_static / TrainStep (SURVEY.md §7 table).")
 
 
 # namespace parity: paddle.static.nn

@@ -125,3 +125,31 @@ def test_static_nn_switch_case():
                            1: lambda: paddle.to_tensor(20.0)},
                           default=lambda: paddle.to_tensor(-1.0))
     assert float(out) == -1.0
+
+
+def test_executor_run_triple_contract(tmp_path):
+    """reference pattern: [prog, feeds, fetches] = load_inference_model(p, exe);
+    exe.run(prog, feed=..., fetch_list=...)."""
+    from paddle_tpu.static import Executor
+    prefix, x, want = _export(tmp_path)
+    exe = Executor()
+    prog, feed_names, fetches = load_inference_model(prefix, executor=exe)
+    assert feed_names == ["x0"]
+    outs = exe.run(prog, feed={"x0": x}, fetch_list=fetches)
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_executor_positional_and_model_paths(tmp_path):
+    """Reference positional form load_inference_model(path, exe) and the
+    model= re-trace path both work with Executor.run."""
+    from paddle_tpu.static import Executor
+    prefix, x, want = _export(tmp_path)
+    exe = Executor()
+    prog, feed_names, fetches = load_inference_model(prefix, exe)  # positional
+    outs = exe.run(prog, feed={"x0": x}, fetch_list=fetches)
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+    # model in the second slot (old keywordless usage) still re-traces
+    net = SmallNet()
+    pred = load_inference_model(prefix, net)
+    out = exe.run(pred, feed={"x0": x})
+    assert out[0].shape == want.shape

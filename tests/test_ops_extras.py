@@ -114,3 +114,28 @@ def test_set_printoptions():
         assert "1.23" in s or "1.2" in s
     finally:
         np.set_printoptions(precision=8)
+
+
+def test_gpt_recompute_parity():
+    """use_recompute must not change the loss (same math, less memory)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    losses = []
+    for use_rc in (False, True):
+        paddle.seed(5)
+        cfg = GPTConfig.tiny()
+        cfg.use_recompute = use_rc
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-3)
+        step = TrainStep(model, lambda lo, la: crit(lo, la), opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randint(
+                0, cfg.vocab_size, (2, 32)).astype(np.int32))
+        run = [float(step(x, x)) for _ in range(3)]
+        losses.append(run)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
