@@ -24,7 +24,7 @@ from .tensor import GradNode, Tensor
 
 _TensorLeaf = lambda x: isinstance(x, Tensor)
 _amp = None  # lazily bound paddle_tpu.amp module
-_flags_registry = None  # lazily bound utils.flags._REGISTRY
+_flags_fast_get = None  # lazily bound utils.flags.fast_get
 
 
 def _is_diff(x) -> bool:
@@ -98,12 +98,12 @@ def _maybe_check_nan_inf(out_leaves, op_name):
     (reference: operator.cc:1252 -> nan_inf_utils_detail CheckVarHasNanOrInf
     — per-op attribution of the first non-finite value).  Eager arrays only;
     traced values are covered by jax debug_nans."""
-    global _flags_registry
-    if _flags_registry is None:
-        from ..utils import flags as _flags_mod
-        _flags_registry = _flags_mod._REGISTRY
+    global _flags_fast_get
+    if _flags_fast_get is None:
+        from ..utils.flags import fast_get as _flags_fast_get_fn
+        _flags_fast_get = _flags_fast_get_fn
     # direct registry read: this gate sits on EVERY eager op dispatch
-    if not _flags_registry.get("check_nan_inf"):
+    if not _flags_fast_get("check_nan_inf"):
         return
     for o in out_leaves:
         if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):

@@ -1,0 +1,212 @@
+"""Pallas TPU LayerNorm + row-softmax kernels (SURVEY.md §7 stage 3 hot set;
+reference CUDA: paddle/phi/kernels/gpu/layer_norm_kernel.cu,
+fused_layernorm_residual_dropout_bias.h; softmax_kernel.cu).
+
+Design: rows (all leading dims flattened) are tiled over a 1-D grid; each
+grid step loads a (BLOCK_ROWS, F) tile into VMEM, computes f32 statistics on
+the VPU, and writes the normalized tile back in the input dtype.  The
+backward kernels recompute x_hat from the saved (mean, rstd) row statistics
+— O(F) memory per row, matching the fused CUDA kernels' design.
+
+NOTE on dispatch: XLA already fuses layer-norm/softmax chains to ~peak on
+TPU (measured — PERF.md), so the framework defaults to the XLA path; these
+kernels are selected via FLAGS_use_pallas_norm=1 and exist as the
+hand-kernel escape hatch (and the pattern template for custom fusions via
+utils.cpp_extension.register_op).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _supported_feature_dim(f: int) -> bool:
+    return f % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)              # (R, F)
+    mean = jnp.mean(x, axis=-1)
+    var = jnp.mean(jnp.square(x), axis=-1) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+    xhat = (x - mean[:, None]) * rstd[:, None]
+    o_ref[...] = (xhat * g_ref[...].astype(jnp.float32)[None, :] +
+                  b_ref[...].astype(jnp.float32)[None, :]).astype(o_ref.dtype)
+    # (R, 1) layout: a bare (R,) f32 output tiles T(256) in Mosaic vs XLA's
+    # T(512) and fails layout verification on real TPUs
+    mean_ref[...] = mean[:, None]
+    rstd_ref[...] = rstd[:, None]
+
+
+def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, do_ref,
+                   dx_ref, dg_ref, db_ref):
+    i = jax.lax.convert_element_type(pl.program_id(0), jnp.int32)
+    x = x_ref[...].astype(jnp.float32)              # (R, F)
+    do = do_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)[None, :]
+    mean = mean_ref[...]            # (R, 1)
+    rstd = rstd_ref[...]
+    xhat = (x - mean) * rstd
+    dxhat = do * g
+    # dx = rstd * (dxhat - mean(dxhat) - xhat * mean(dxhat * xhat))
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (dxhat - m1 - xhat * m2)).astype(dx_ref.dtype)
+    # parameter grads accumulate across the sequential row-block grid
+    @pl.when(i == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+    dg_ref[...] = dg_ref[...] + jnp.sum(do * xhat, axis=0)
+    db_ref[...] = db_ref[...] + jnp.sum(do, axis=0)
+
+
+def _ln_fwd(x2, gamma, beta, eps, block_rows, interpret):
+    n, f = x2.shape
+    nb = n // block_rows
+    out, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, f), x2.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, gamma, beta)
+    return out, mean, rstd
+
+
+def _ln_bwd(x2, gamma, mean, rstd, do2, block_rows, interpret):
+    n, f = x2.shape
+    nb = n // block_rows
+    dx, dg, db = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),       # revisited accumulator
+            pl.BlockSpec((f,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, f), x2.dtype),
+            jax.ShapeDtypeStruct((f,), jnp.float32),
+            jax.ShapeDtypeStruct((f,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x2, gamma, mean, rstd, do2)
+    return dx, dg, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def layer_norm_pallas(x, gamma, beta, eps=1e-5,
+                      block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
+    """LayerNorm over the last dim.  x: (..., F); gamma/beta: (F,).
+    Requires F % 128 == 0 and rows % block_rows == 0 (supported() gates)."""
+    out, _, _ = _ln_core(x, gamma, beta, eps, block_rows, interpret)
+    return out
+
+
+def _ln_core(x, gamma, beta, eps, block_rows, interpret):
+    f = x.shape[-1]
+    x2 = x.reshape(-1, f)
+    n = x2.shape[0]
+    br = min(block_rows, n)
+    while br > 8 and n % br:
+        br //= 2
+    if n % br or not _supported_feature_dim(f):
+        raise ValueError(
+            f"layer_norm_pallas: shape ({n}, {f}) not tileable "
+            f"(rows %% {br}, feature %% 128)")
+    with jax.enable_x64(False):
+        out, mean, rstd = _ln_fwd(x2, gamma, beta, eps, br, interpret)
+    return out.reshape(x.shape), mean, rstd
+
+
+def _ln_vjp_fwd(x, gamma, beta, eps, block_rows, interpret):
+    out, mean, rstd = _ln_core(x, gamma, beta, eps, block_rows, interpret)
+    return out, (x, gamma, mean, rstd)
+
+
+def _ln_vjp_bwd(eps, block_rows, interpret, res, g):
+    x, gamma, mean, rstd = res
+    f = x.shape[-1]
+    x2 = x.reshape(-1, f)
+    n = x2.shape[0]
+    br = min(block_rows, n)
+    while br > 8 and n % br:
+        br //= 2
+    with jax.enable_x64(False):
+        dx, dg, db = _ln_bwd(x2, gamma, mean, rstd, g.reshape(-1, f), br,
+                             interpret)
+    return (dx.reshape(x.shape), dg.astype(gamma.dtype),
+            db.astype(gamma.dtype))
+
+
+layer_norm_pallas.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# row softmax
+# ---------------------------------------------------------------------------
+
+def _softmax_kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def softmax_pallas(x, block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
+    """Numerically-stable softmax over the last dim (f32 statistics).
+    Differentiable via jax's autodiff over the kernel's XLA recompute is NOT
+    provided — use for inference paths; training softmax lives inside the
+    flash-attention kernels."""
+    f = x.shape[-1]
+    x2 = x.reshape(-1, f)
+    n = x2.shape[0]
+    br = min(block_rows, n)
+    while br > 8 and n % br:
+        br //= 2
+    if n % br or not _supported_feature_dim(f):
+        raise ValueError(
+            f"softmax_pallas: shape ({n}, {f}) not tileable")
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _softmax_kernel,
+            grid=(n // br,),
+            in_specs=[pl.BlockSpec((br, f), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((br, f), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, f), x.dtype),
+            interpret=interpret,
+        )(x2)
+    return out.reshape(x.shape)

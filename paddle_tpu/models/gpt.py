@@ -188,13 +188,16 @@ class GPTModel(Layer):
         x = self.drop(x)
         x = with_sharding_constraint(x, PartitionSpec("dp", "sep", None))
         new_caches = []
+        if self.config.use_recompute and self.training and cache is None:
+            from ..distributed.recompute import recompute as _recompute
+        else:
+            _recompute = None
         for i, block in enumerate(self.h):
             if cache is not None:
                 x, ci = block(x, cache[i])
                 new_caches.append(ci)
-            elif self.config.use_recompute and self.training:
-                from ..distributed.recompute import recompute
-                x = recompute(block, x)
+            elif _recompute is not None:
+                x = _recompute(block, x)
             else:
                 x = block(x)
         x = self.ln_f(x)

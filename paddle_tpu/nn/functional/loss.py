@@ -41,9 +41,12 @@ def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
     m = jax.lax.stop_gradient(jnp.max(lf, axis=axis))
     lse = m + jnp.log(jnp.sum(jnp.exp(lf - jnp.expand_dims(m, axis)),
                               axis=axis))
-    t = jnp.take_along_axis(
-        lf, jnp.expand_dims(jnp.clip(lbl, 0, logits.shape[axis] - 1), axis),
-        axis=axis)
+    # gather under x64-off: take_along_axis promotes its index math to
+    # s64 in x64 mode, putting emulated 64-bit ops into the TPU program
+    # (caught by tests/test_x64_audit.py)
+    with jax.enable_x64(False):
+        idx = jnp.clip(lbl, 0, logits.shape[axis] - 1).astype(jnp.int32)
+        t = jnp.take_along_axis(lf, jnp.expand_dims(idx, axis), axis=axis)
     nll = lse - jnp.squeeze(t, axis)
     mask = (lbl != ignore_index)
     return jnp.where(mask, nll, 0.0)

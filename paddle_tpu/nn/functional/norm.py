@@ -1,8 +1,9 @@
 """Normalization functionals (reference: python/paddle/nn/functional/norm.py).
 
-layer_norm is the Pallas-fused hot path (paddle_tpu.kernels.layernorm) with a
-pure-XLA fallback; batch_norm keeps running stats on the layer like the
-reference (paddle/phi/kernels/gpu/batch_norm_kernel.cu semantics).
+layer_norm runs on the XLA-fused path by default (measured at peak on TPU —
+PERF.md); FLAGS_use_pallas_norm=1 opts into the hand kernel in
+kernels/norm_pallas.py.  batch_norm keeps running stats on the layer like
+the reference (paddle/phi/kernels/gpu/batch_norm_kernel.cu semantics).
 """
 from __future__ import annotations
 
@@ -12,10 +13,27 @@ import jax.numpy as jnp
 from ...core.dispatch import call, wrap_op
 from ...core.tensor import Tensor
 
+def _use_pallas_norm() -> bool:
+    from ...utils.flags import fast_get
+    return bool(fast_get("use_pallas_norm"))
+
 
 def layer_norm_raw(x, weight, bias, normalized_shape, epsilon=1e-5):
     n_axes = len(normalized_shape) if isinstance(normalized_shape, (list, tuple)) else 1
     axes = tuple(range(x.ndim - n_axes, x.ndim))
+    if _use_pallas_norm() and n_axes == 1 and weight is not None \
+            and bias is not None and x.shape[-1] % 128 == 0:
+        # hand-kernel path (FLAGS_use_pallas_norm=1): XLA's fused LN is
+        # already at peak (PERF.md), so this is opt-in
+        from ...kernels.norm_pallas import (DEFAULT_BLOCK_ROWS,
+                                            layer_norm_pallas)
+        rows = 1
+        for s in x.shape[:-1]:
+            rows *= s
+        if rows % 8 == 0:
+            interpret = jax.default_backend() != "tpu"
+            return layer_norm_pallas(x, weight, bias, epsilon,
+                                     DEFAULT_BLOCK_ROWS, interpret)
     # statistics in f32 regardless of activation dtype, output cast back to
     # the input dtype: keeps bf16 activations bf16 through the residual
     # stream (an f32-promoting LN silently turns every downstream matmul

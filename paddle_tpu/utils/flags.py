@@ -36,6 +36,13 @@ def set_flags(flags: Dict[str, Any]):
         _REGISTRY[k] = v
 
 
+def fast_get(name: str):
+    """Hot-path flag read: direct registry access, no dict building.
+    Safe to cache the bound function — the registry dict is mutated in
+    place by set_flags, never replaced."""
+    return _REGISTRY.get(name)
+
+
 def get_flags(names=None):
     if names is None:
         return dict(_REGISTRY)
@@ -52,6 +59,9 @@ def get_flags(names=None):
 define_flag("check_nan_inf", False,
             "check every op output for NaN/Inf (reference operator.cc:1252)")
 define_flag("use_flash_attention", True, "route attention through Pallas")
+define_flag("use_pallas_norm", False,
+            "route layer_norm through the Pallas kernel (XLA's fused LN is "
+            "already at peak; opt-in escape hatch)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")
