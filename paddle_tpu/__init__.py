@@ -58,7 +58,7 @@ from . import text          # noqa: E402
 from . import onnx          # noqa: E402
 from . import profiler      # noqa: E402
 from . import hapi          # noqa: E402
-from .hapi import Model     # noqa: E402
+from .hapi import Model, flops, summary  # noqa: E402
 from .framework import load, save  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
 from .nn import DataParallel  # noqa: E402

@@ -17,7 +17,7 @@ from ..jit import TrainStep, functional_call
 from ..metric import Metric
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "summary"]
+           "EarlyStopping", "LRScheduler", "summary", "flops"]
 
 
 class Callback:
@@ -333,3 +333,65 @@ def summary(net, input_size=None, dtypes=None):
     lines.append(f"Trainable params: {trainable:,}")
     print("\n".join(lines))
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, dtypes=None, custom_ops=None,
+          print_detail=False):
+    """Model FLOPs (reference: hapi/dynamic_flops.py paddle.flops).
+
+    TPU-native: instead of per-layer-type formulas, the forward is traced
+    and compiled and XLA's own cost analysis reports the FLOPs of the
+    compiled graph (fusions included).  Limitation: custom-call regions
+    (Pallas kernels) are opaque to XLA cost analysis and count as 0;
+    ``custom_ops`` hooks are therefore not supported — measure such models
+    with the profiler instead (PERF.md methodology).
+
+    ``dtypes``: one dtype string or a list matching input_size (default
+    float32) — integer-input models (Embedding-first) need e.g. "int32".
+    """
+    import jax
+
+    from ..core.dtype import convert_dtype
+    from ..jit import functional_call
+
+    if custom_ops is not None:
+        raise NotImplementedError(
+            "flops(custom_ops=...) is not supported on the TPU build: XLA "
+            "cost analysis counts compiled HLO only (custom Pallas calls "
+            "are opaque); use jax.profiler / PERF.md methodology instead")
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops needs input_size=[shape, ...] or inputs")
+        shapes = input_size if isinstance(input_size[0], (list, tuple)) \
+            else [input_size]
+        if dtypes is None:
+            dts = ["float32"] * len(shapes)
+        elif isinstance(dtypes, str):
+            dts = [dtypes] * len(shapes)
+        else:
+            dts = list(dtypes)
+        inputs = [jax.ShapeDtypeStruct(tuple(int(d) for d in s),
+                                       convert_dtype(dt))
+                  for s, dt in zip(shapes, dts)]
+    else:
+        inputs = [i._array if hasattr(i, "_array") else i for i in inputs]
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        state = net.functional_state()
+
+        def fwd(state, *args):
+            out, _ = functional_call(net, state, *args)
+            return out
+
+        compiled = jax.jit(fwd).lower(state, *inputs).compile()
+    finally:
+        if was_training:
+            net.train()
+    ca = compiled.cost_analysis() or {}
+    total = int(ca.get("flops", 0))
+    if print_detail:
+        print(f"FLOPs (XLA cost analysis): {total:,}")
+        if "bytes accessed" in ca:
+            print(f"Bytes accessed: {int(ca['bytes accessed']):,}")
+    return total

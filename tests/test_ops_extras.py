@@ -139,3 +139,28 @@ def test_gpt_recompute_parity():
         run = [float(step(x, x)) for _ in range(3)]
         losses.append(run)
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+def test_summary_and_flops():
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 10))
+    info = paddle.summary(net)
+    assert info["total_params"] == 64 * 128 + 128 + 128 * 10 + 10
+    f = paddle.flops(net, input_size=[8, 64])
+    # ~2*(8*64*128 + 8*128*10) plus bias/relu epsilon
+    assert 140_000 < f < 200_000
+    with pytest.raises(ValueError):
+        paddle.flops(net)
+
+
+def test_flops_dtypes_and_mode_restore():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    net = GPTForCausalLM(GPTConfig.tiny())
+    net.train()
+    f = paddle.flops(net, input_size=[2, 16], dtypes="int32")
+    assert f > 0
+    assert net.training  # mode restored
+    with pytest.raises(NotImplementedError):
+        paddle.flops(net, input_size=[2, 16], dtypes="int32",
+                     custom_ops={object: None})
