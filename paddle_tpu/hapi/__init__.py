@@ -352,7 +352,6 @@ def flops(net, input_size=None, inputs=None, dtypes=None, custom_ops=None,
     import jax
 
     from ..core.dtype import convert_dtype
-    from ..jit import functional_call
 
     if custom_ops is not None:
         raise NotImplementedError(
@@ -370,6 +369,10 @@ def flops(net, input_size=None, inputs=None, dtypes=None, custom_ops=None,
             dts = [dtypes] * len(shapes)
         else:
             dts = list(dtypes)
+            if len(dts) != len(shapes):
+                raise ValueError(
+                    f"dtypes has {len(dts)} entries for {len(shapes)} "
+                    "input shapes")
         inputs = [jax.ShapeDtypeStruct(tuple(int(d) for d in s),
                                        convert_dtype(dt))
                   for s, dt in zip(shapes, dts)]

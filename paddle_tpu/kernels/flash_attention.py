@@ -47,10 +47,6 @@ def supported(q, k=None) -> bool:
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """q,k,v: (B, S, H, D) -> (B, S, H, D)."""
-    from .flash_attention_pallas import flash_attention_bhsd
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    out = flash_attention_bhsd(qt, kt, vt, causal=causal, scale=scale)
-    return jnp.swapaxes(out, 1, 2)
+    """q,k,v: (B, S, H, D) -> (B, S, H, D) — native layout, no transposes."""
+    from .flash_attention_pallas import flash_attention_bshd_native
+    return flash_attention_bshd_native(q, k, v, causal=causal, scale=scale)

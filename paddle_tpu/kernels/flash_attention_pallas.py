@@ -1,16 +1,27 @@
 """Pallas TPU flash-attention kernels (forward + backward).
 
-Blockwise streaming-softmax attention (Flash-Attention style): the query
-block lives in VMEM, K/V are scanned block-by-block with running (max, sum,
-acc) statistics in fp32, so score matrices never materialise in HBM —
-O(S) memory instead of the reference FMHA's O(S^2)
+Blockwise streaming-softmax attention (Flash-Attention style): running
+(max, sum, acc) statistics in fp32, so score matrices never materialise in
+HBM — O(S) memory instead of the reference FMHA's O(S^2)
 (paddle/fluid/operators/fused/fmha_ref.h).
 
-Backward is a pair of dedicated Pallas kernels (FlashAttention-2 style):
-* dQ kernel: grid over query blocks, scans key blocks, recomputes the
-  probability block from the saved logsumexp — no O(S^2) materialisation.
-* dK/dV kernel: grid over key blocks, scans query blocks.
-Both accumulate in fp32 and write grads in the input dtype.
+Layout: the kernels are NATIVE to the model's (B, S, H, D) activations,
+viewed as (B, S, H*D).  Head groups are a GRID dimension over the folded
+H*D axis (`hg` heads per cell so hg*D is lane-aligned, i.e. % 128), and the
+per-head attention math runs as a static loop inside the cell.  This
+removes the six (B,S,H,D) <-> (B,H,S,D) transposes per layer that a
+head-major kernel forces around every call — measured ~9 ms/step of pure
+HBM copies on the GPT-2 345M bench (PERF.md).
+
+Backward is ONE merged kernel producing dQ, dK and dV: the textbook
+two-kernel FlashAttention-2 split recomputes the logits and dP matmuls
+twice; merging halves that recompute and saves a launch per layer.
+Grid = (B, n_hg, nk, nq) with both inner dims sequential: dK/dV accumulate
+per key block in scratch (reset at qi==0), dQ accumulates across the whole
+(nk, nq) sweep in a full-sequence f32 scratch written at the final step.
+
+Causal masking skips fully-masked blocks via pl.when (no MXU/VPU work; the
+static grid still streams the prefetch, which is the price of pipelining).
 """
 from __future__ import annotations
 
@@ -20,11 +31,12 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
+from jax.experimental.pallas import tpu as pltpu
+
 
 def _block_env(name, default):
     """Power-of-two >=128 only: the divisibility-fallback loop in
-    flash_attention_bhsd halves the block until it divides the sequence, so
+    flash_attention_bshd halves the block until it divides the sequence, so
     a non-power-of-two would turn supported() shapes into dispatch errors."""
     raw = os.getenv(name)
     if not raw:
@@ -42,148 +54,188 @@ DEFAULT_BLOCK_Q = _block_env("PADDLE_TPU_FLASH_BLOCK_Q", 512)
 DEFAULT_BLOCK_K = _block_env("PADDLE_TPU_FLASH_BLOCK_K", 512)
 _NEG_INF = -1e30
 
+_SEQ2 = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"))
+
 
 def _i32(v):
     return jnp.asarray(v, jnp.int32)
+
+
+def _pid(i):
+    # strong int32: program_id is weakly typed and x64 mode would promote
+    # its arithmetic to i64, which mosaic cannot lower
+    return jax.lax.convert_element_type(pl.program_id(i), jnp.int32)
+
+
+def _pick_head_group(h: int, d: int):
+    """Heads per grid cell: hg*d must be lane-aligned (%128) and divide h.
+    Picks the LARGEST group with hg*d <= 256 — bigger groups amortize grid
+    overhead (+0.8k tokens/s measured on the 345M bench) but the backward's
+    scratch (full-sequence dq + dk/dv accumulators) scales with hg*d and
+    hg*d=512 blew the 16MB VMEM budget by 156KB at s=1024.
+    Fallback: ALL heads in one group — a block spanning the entire folded
+    axis is legal regardless of alignment (block dim == array dim)."""
+    forced = os.getenv("PADDLE_TPU_FLASH_HEAD_GROUP")
+    if forced:
+        try:
+            hg = int(forced)
+            if h % hg == 0 and ((hg * d) % 128 == 0 or hg == h):
+                return hg
+        except ValueError:
+            pass
+    # largest lane-aligned group with hg*d <= 256: amortizes grid overhead
+    # (measured +0.8k tokens/s over hg*d=128 on the 345M bench) while the
+    # backward's scratch stays inside the 16MB VMEM budget (hg*d=512
+    # overflowed by 156KB at s=1024)
+    for hg in (8, 4, 2, 1):
+        if h % hg == 0 and (hg * d) % 128 == 0 and hg * d <= 256:
+            return hg
+    for hg in (1, 2, 4, 8):
+        if h % hg == 0 and (hg * d) % 128 == 0:
+            return hg
+    return h
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
-                block_k):
-    # q_ref: (1, BQ, D); k_ref/v_ref: (1, S, D); o_ref: (1, BQ, D)
-    # lse_ref: (1, NQ, BQ) — per-row logsumexp of the scaled (masked)
-    # logits, saved for the backward kernels.  The (NQ, BQ) layout is the
-    # (S,) row vector folded to satisfy TPU (8,128) tiling: the whole
-    # per-(b,h) slice stays resident across the sequential q-block grid
-    # steps and each step writes its own row.
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
+                causal, scale, hg, d, nk):
+    # q/o: (1, BQ, HG*D); k/v: (1, BK, HG*D) — ki-th block, streamed by the
+    # grid; lse: (1, 1, HG, NQ, BQ); scratch m/l: (HG, BQ) f32,
+    # acc: (BQ, HG*D) f32, persistent across the sequential ki iterations.
     block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    s = k_ref.shape[1]
-    # strong int32: program_id is weakly typed and x64 mode would promote
-    # its arithmetic to i64, which mosaic cannot lower
-    qi = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
+    block_k = k_ref.shape[1]
+    qi = _pid(2)
+    ki = _pid(3)
 
-    # keep operands in the input dtype (bf16 on the hot path): the MXU's
-    # native mode is bf16 x bf16 -> f32 accumulate; upcasting operands to
-    # f32 before the dot quarters matmul throughput (measured: the fwd
-    # kernel went from ~1.9ms to MXU-bound after this change)
-    q = q_ref[0]                                           # (BQ, D)
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    m0 = jnp.full((block_q,), jnp.float32(_NEG_INF), jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
-        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def make_body(masked):
-        def body(kb, carry):
-            m, l, acc = carry
-            start = jax.lax.mul(kb, _i32(block_k))
-            k = k_ref[0, pl.ds(start, block_k), :]
-            v = v_ref[0, pl.ds(start, block_k), :]
+    def _attend(masked):
+        if masked:
+            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = col_ids <= row_ids
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0, :, sl]                               # (BQ, D)
+            k = k_ref[0, :, sl]                               # (BK, D)
+            v = v_ref[0, :, sl]
+            # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
+            # operands first quarters matmul throughput
             logits = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * jnp.float32(scale)
             if masked:
-                col_ids = start[None, None] + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(col_ids <= row_ids, logits,
-                                   jnp.float32(_NEG_INF))
-            blk_max = jnp.max(logits, axis=-1)
-            new_m = jnp.maximum(m, blk_max)
+                logits = jnp.where(mask, logits, jnp.float32(_NEG_INF))
+            m = m_sc[hh]
+            new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
             correction = jnp.exp(m - new_m)
             p = jnp.exp(logits - new_m[:, None])
-            new_l = l * correction + jnp.sum(p, axis=-1)
-            new_acc = acc * correction[:, None] + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return new_m, new_l, new_acc
-        return body
+            l_sc[hh] = l_sc[hh] * correction + jnp.sum(p, axis=-1)
+            acc_sc[:, sl] = acc_sc[:, sl] * correction[:, None] + \
+                jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_sc[hh] = new_m
 
     if causal:
-        assert block_q % block_k == 0
-        # visible blocks split into fully-visible (no mask arithmetic — the
-        # where/iota VPU work is ~half the kernel at these shapes) and the
-        # diagonal band (block_q//block_k partially masked blocks)
-        ratio = _i32(block_q // block_k)
-        num_full = jax.lax.mul(qi, ratio)
-        carry = jax.lax.fori_loop(_i32(0), num_full, make_body(False),
-                                  (m0, l0, acc0))
-        m, l, acc = jax.lax.fori_loop(num_full,
-                                      jax.lax.add(num_full, ratio),
-                                      make_body(True), carry)
+        # split visible blocks into fully-visible (no mask arithmetic —
+        # the iota/where VPU work is significant at these shapes) and the
+        # diagonal band (masked); the two pl.when branches are disjoint
+        first_row = jax.lax.mul(qi, _i32(block_q))
+        last_row = first_row + _i32(block_q - 1)
+        last_col = jax.lax.mul(ki, _i32(block_k)) + _i32(block_k - 1)
+        fully_visible = last_col <= first_row
+        diagonal = jnp.logical_and(last_col > first_row,
+                                   jax.lax.mul(ki, _i32(block_k)) <=
+                                   last_row)
+
+        @pl.when(fully_visible)
+        def _compute_full():
+            _attend(False)
+
+        @pl.when(diagonal)
+        def _compute_diag():
+            _attend(True)
     else:
-        num_kb = _i32(s // block_k)
-        m, l, acc = jax.lax.fori_loop(_i32(0), num_kb, make_body(False),
-                                      (m0, l0, acc0))
-    l_safe = jnp.maximum(l, jnp.float32(1e-30))
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, pl.ds(qi, 1), :] = (m + jnp.log(l_safe))[None, :]
+        _attend(False)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            l_safe = jnp.maximum(l_sc[hh], jnp.float32(1e-30))
+            o_ref[0, :, sl] = (acc_sc[:, sl] /
+                               l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
+                (m_sc[hh] + jnp.log(l_safe))[None, :]
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret=False):
-    # trace the kernel with x64 off: the global x64 mode (needed for paddle's
-    # int64 semantics) surfaces i64/f64 intermediates that mosaic cannot lower
+def _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
+               interpret=False):
+    # trace with x64 off: the global x64 mode (needed for paddle's int64
+    # semantics) surfaces i64/f64 intermediates that mosaic cannot lower
     with jax.enable_x64(False):
-        return _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k,
-                                interpret)
+        return _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k,
+                                hg, d, interpret)
 
 
-def _flash_fwd_inner(q, k, v, causal, scale, block_q, block_k, interpret):
-    b, h, s, d = q.shape
-    bh = b * h
-    q3 = q.reshape(bh, s, d)
-    k3 = k.reshape(bh, k.shape[2], d)
-    v3 = v.reshape(bh, v.shape[2], d)
+def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
+                     interpret):
+    b, s, hd = q3.shape
+    sk = k3.shape[1]
+    n_hg = hd // (hg * d)
     nq = s // block_q
+    nk = sk // block_k
+    hgd = hg * d
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               block_k=block_k)
+                               hg=hg, d=d, nk=nk)
+    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i, j: (bi, i, g))
+    kv_spec = pl.BlockSpec((1, block_k, hgd), lambda bi, g, i, j: (bi, j, g))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, k3.shape[1], d), lambda bi, i: (bi, 0, 0)),
-            pl.BlockSpec((1, v3.shape[1], d), lambda bi, i: (bi, 0, 0)),
-        ],
+        grid=(b, n_hg, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, nq, block_q), lambda bi, i: (bi, 0, 0)),
+            q_spec,
+            pl.BlockSpec((1, 1, hg, nq, block_q),
+                         lambda bi, g, i, j: (bi, g, 0, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, nq, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
+            jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((hg, block_q), jnp.float32),
+            pltpu.VMEM((hg, block_q), jnp.float32),
+            pltpu.VMEM((block_q, hgd), jnp.float32),
+        ],
+        compiler_params=_SEQ2,
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, s, d), lse  # lse stays (bh, nq, block_q)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
-# backward
+# backward (merged dQ/dK/dV)
 # ---------------------------------------------------------------------------
 
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc, *,
-                causal, scale, nq, nk):
-    """Merged FlashAttention-2 backward: ONE kernel produces dQ, dK and dV.
-
-    The textbook two-kernel split (dQ over q-blocks, dK/dV over k-blocks)
-    recomputes the logits and dP matmuls twice; merging halves that
-    recompute and saves a kernel launch per layer.  Grid = (bh, nk, nq),
-    both inner dims sequential: dK/dV accumulate per key block in scratch
-    (reset at qi==0), while dQ accumulates across the WHOLE (nk, nq) sweep
-    in a full-sequence f32 scratch, written once at the final step.
-    q/do (1, BQ, D) stream with qi; k/v (1, BK, D) with ki; lse/delta come
-    in the folded (1, NQ, BQ) row layout (see _fwd_kernel)."""
+                causal, scale, hg, d, nq, nk):
     block_k = k_ref.shape[1]
     block_q = q_ref.shape[1]
-    ki = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
-    qi = jax.lax.convert_element_type(pl.program_id(2), jnp.int32)
+    ki = _pid(2)
+    qi = _pid(3)
 
     @pl.when(jnp.logical_and(ki == 0, qi == 0))
     def _init_dq():
@@ -196,48 +248,50 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     live = True
     if causal:
-        # the block is fully masked iff even its last row precedes the
-        # first key column
         live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
             jax.lax.mul(ki, _i32(block_k))
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0]                              # (BQ, D) input dtype
-        k = k_ref[0]                              # (BK, D)
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0, pl.ds(qi, 1), :][0]      # (BQ,) f32
-        delta = delta_ref[0, pl.ds(qi, 1), :][0]  # (BQ,) f32
-        logits = jnp.float32(scale) * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)   # (BQ, BK)
-        p = jnp.exp(logits - lse[:, None])
         if causal:
             row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(col_ids <= row_ids, p, jnp.float32(0.0))
-        pc = p.astype(do.dtype)
-        # dV += P^T dO
-        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
-            pc, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)   # (BK, D)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)   # (BQ, BK)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
-        # dK += dS^T Q
-        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)   # (BK, D)
-        # dQ rows qi += dS K
+            mask = col_ids <= row_ids
         row0 = jax.lax.mul(qi, _i32(block_q))
-        dq_sc[pl.ds(row0, block_q), :] = \
-            dq_sc[pl.ds(row0, block_q), :] + jax.lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0, :, sl]                       # (BQ, D) input dtype
+            k = k_ref[0, :, sl]                       # (BK, D)
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # (BQ,) f32
+            delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]  # (BQ,) f32
+            logits = jnp.float32(scale) * jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (BQ, BK)
+            p = jnp.exp(logits - lse[:, None])
+            if causal:
+                p = jnp.where(mask, p, jnp.float32(0.0))
+            pc = p.astype(do.dtype)
+            # dV += P^T dO
+            dv_sc[:, sl] = dv_sc[:, sl] + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (BK, D)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (BQ, BK)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            # dK += dS^T Q
+            dk_sc[:, sl] = dk_sc[:, sl] + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (BK, D)
+            # dQ rows qi += dS K
+            dq_sc[pl.ds(row0, block_q), sl] = \
+                dq_sc[pl.ds(row0, block_q), sl] + jax.lax.dot_general(
+                    ds, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize_kv():
@@ -249,61 +303,58 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (jnp.float32(scale) * dq_sc[...]).astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-               interpret=False):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
+               hg, d, interpret=False):
     with jax.enable_x64(False):
-        return _flash_bwd_inner(q, k, v, o, lse, do, causal, scale,
-                                block_q, block_k, interpret)
+        return _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale,
+                                block_q, block_k, hg, d, interpret)
 
 
-def _flash_bwd_inner(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                     interpret):
-    b, h, s, d = q.shape
-    sk = k.shape[2]
-    bh = b * h
-    q3 = q.reshape(bh, s, d)
-    k3 = k.reshape(bh, sk, d)
-    v3 = v.reshape(bh, sk, d)
-    do3 = do.reshape(bh, s, d)
+def _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
+                     block_k, hg, d, interpret):
+    b, s, hd = q3.shape
+    sk = k3.shape[1]
+    h = hd // d
+    n_hg = h // hg
     nq = s // block_q
     nk = sk // block_k
-    lse3 = lse  # already (bh, nq, block_q), folded row layout
-    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA; same folded layout
-    delta3 = jnp.sum(do3.astype(jnp.float32) *
-                     o.reshape(bh, s, d).astype(jnp.float32),
-                     axis=-1).reshape(bh, nq, block_q)
+    hgd = hg * d
+    # delta = rowsum(dO * O) per head — cheap, fused by XLA; folded to the
+    # same (b, n_hg, hg, nq, bq) row layout as lse
+    delta = jnp.sum(
+        do3.reshape(b, s, h, d).astype(jnp.float32) *
+        o3.reshape(b, s, h, d).astype(jnp.float32), axis=-1)       # (b,s,h)
+    delta = jnp.moveaxis(delta, -1, 1).reshape(b, n_hg, hg, nq, block_q)
 
-    q_spec = pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, i, 0))
-    row_spec = pl.BlockSpec((1, nq, block_q), lambda bi, i, j: (bi, 0, 0))
+    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i, j: (bi, j, g))
+    kv_spec = pl.BlockSpec((1, block_k, hgd), lambda bi, g, i, j: (bi, i, g))
+    row_spec = pl.BlockSpec((1, 1, hg, nq, block_q),
+                            lambda bi, g, i, j: (bi, g, 0, 0, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, causal=causal, scale=scale,
-                          nq=nq, nk=nk),
-        grid=(bh, nk, nq),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+                          hg=hg, d=d, nq=nq, nk=nk),
+        grid=(b, n_hg, nk, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[
             # dq: whole-sequence block, revisited; written at the last step
-            pl.BlockSpec((1, s, d), lambda bi, i, j: (bi, 0, 0)),
-            k_spec,
-            k_spec,
+            pl.BlockSpec((1, s, hgd), lambda bi, g, i, j: (bi, 0, g)),
+            kv_spec,
+            kv_spec,
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
+            jax.ShapeDtypeStruct((b, sk, hd), k3.dtype),
+            jax.ShapeDtypeStruct((b, sk, hd), v3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((s, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((s, hgd), jnp.float32),
+            pltpu.VMEM((block_k, hgd), jnp.float32),
+            pltpu.VMEM((block_k, hgd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        compiler_params=_SEQ2,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
-
-    return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -321,45 +372,68 @@ def _reference_bhsd(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, causal, scale, block_q, block_k, hg, d, interpret):
+    out, _ = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
+                        interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
+                   interpret):
+    out, lse = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg,
+                          d, interpret)
+    return out, (q3, k3, v3, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                      interpret)
+def _flash_vjp_bwd(causal, scale, block_q, block_k, hg, d, interpret, res, g):
+    q3, k3, v3, out, lse = res
+    return _flash_bwd(q3, k3, v3, out, lse, g, causal, scale, block_q,
+                      block_k, hg, d, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention_bhsd(q, k, v, causal=False, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         interpret=False):
-    """q,k,v: (B, H, S, D)."""
+def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
+                                block_q=DEFAULT_BLOCK_Q,
+                                block_k=DEFAULT_BLOCK_K, interpret=False):
+    """q,k,v: (B, S, H, D) — the model's native layout; no transposes."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
     if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = q.shape[2]
+        scale = 1.0 / (d ** 0.5)
+    hg = _pick_head_group(h, d)
     block_q = min(block_q, s)
-    block_k = min(block_k, k.shape[2])
-    # shrink to the largest divisible block (the causal kernels also need
-    # block_q % block_k == 0, so keep them locked together when possible)
+    block_k = min(block_k, sk)
+    # shrink to the largest divisible block
     while block_q > 128 and s % block_q:
         block_q //= 2
-    while block_k > 128 and (k.shape[2] % block_k or block_q % block_k):
+    while block_k > 128 and sk % block_k:
         block_k //= 2
-    if s % block_q or k.shape[2] % block_k:
+    if s % block_q or sk % block_k:
         raise ValueError(
             "flash_attention: seq lengths (%d, %d) must be divisible by "
             "block sizes (%d, %d) — ragged tails would be silently dropped; "
             "use the XLA path (kernels.flash_attention.supported() gates "
-            "this)" % (s, k.shape[2], block_q, block_k))
-    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)
+            "this)" % (s, sk, block_q, block_k))
+    q3 = q.reshape(b, s, h * d)
+    k3 = k.reshape(b, sk, h * d)
+    v3 = v.reshape(b, sk, h * d)
+    out = _flash(q3, k3, v3, causal, float(scale), block_q, block_k, hg, d,
+                 interpret)
+    return out.reshape(b, s, h, d)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
+    """q,k,v: (B, H, S, D) — compat wrapper over the native BSHD kernel
+    (introduces two transposes; the model path uses BSHD directly)."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bshd_native(qt, kt, vt, causal=causal, scale=scale,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
+    return jnp.swapaxes(out, 1, 2)
