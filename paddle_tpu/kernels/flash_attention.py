@@ -40,10 +40,15 @@ def supported(q, k=None) -> bool:
         return False
     if q.ndim != 4:
         return False
-    s, d = q.shape[1], q.shape[3]
+    s, h, d = q.shape[1], q.shape[2], q.shape[3]
     if k is not None and k.shape[1] != s:
         return False
-    return s % _DEFAULT_BLOCK_Q == 0 and d in (64, 128, 256)
+    if s % _DEFAULT_BLOCK_Q or d not in (64, 128, 256):
+        return False
+    # the forward holds K+V VMEM-resident; very long sequences exceed the
+    # budget and must take the XLA path
+    from .flash_attention_pallas import max_supported_seq
+    return s <= max_supported_seq(h, d)
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):

@@ -13,15 +13,22 @@ removes the six (B,S,H,D) <-> (B,H,S,D) transposes per layer that a
 head-major kernel forces around every call — measured ~9 ms/step of pure
 HBM copies on the GPT-2 345M bench (PERF.md).
 
+Forward: grid (B, n_hg, nq); the whole K/V sequence stays VMEM-resident and
+is scanned with fori loops (measured faster at these shapes than streaming
+K/V blocks through the grid — the extra grid steps only added overhead).
+Causal q-blocks split the scan into mask-free fully-visible blocks and the
+masked diagonal band.
+
 Backward is ONE merged kernel producing dQ, dK and dV: the textbook
 two-kernel FlashAttention-2 split recomputes the logits and dP matmuls
 twice; merging halves that recompute and saves a launch per layer.
 Grid = (B, n_hg, nk, nq) with both inner dims sequential: dK/dV accumulate
 per key block in scratch (reset at qi==0), dQ accumulates across the whole
 (nk, nq) sweep in a full-sequence f32 scratch written at the final step.
-
 Causal masking skips fully-masked blocks via pl.when (no MXU/VPU work; the
 static grid still streams the prefetch, which is the price of pipelining).
+A fori-style backward (K/V outer, q scanned inside) was measured SLOWER
+(47.6k vs 49.6k tokens/s on the 345M bench) — fwd and bwd optimum differ.
 """
 from __future__ import annotations
 
@@ -68,14 +75,31 @@ def _pid(i):
     return jax.lax.convert_element_type(pl.program_id(i), jnp.int32)
 
 
-def _pick_head_group(h: int, d: int):
+# VMEM spent on the forward's resident K+V per grid cell is
+# s * hg*d * 2 arrays * 2 B (bf16), double-buffered by the pipeline;
+# keep it under this budget (of the ~16MB per-core VMEM) so the q block,
+# logits and accumulators still fit.
+_RESIDENT_KV_BUDGET = 4 * 1024 * 1024
+
+
+def _aligned_groups(h: int, d: int):
+    out = [hg for hg in (8, 4, 2, 1)
+           if h % hg == 0 and (hg * d) % 128 == 0]
+    if not out:
+        out = [h]  # whole folded axis: legal regardless of alignment
+    return out
+
+
+def _pick_head_group(h: int, d: int, s: int):
     """Heads per grid cell: hg*d must be lane-aligned (%128) and divide h.
     Picks the LARGEST group with hg*d <= 256 — bigger groups amortize grid
-    overhead (+0.8k tokens/s measured on the 345M bench) but the backward's
-    scratch (full-sequence dq + dk/dv accumulators) scales with hg*d and
-    hg*d=512 blew the 16MB VMEM budget by 156KB at s=1024.
-    Fallback: ALL heads in one group — a block spanning the entire folded
-    axis is legal regardless of alignment (block dim == array dim)."""
+    overhead (+0.8k tokens/s measured on the 345M bench) — that also keeps
+    the forward's VMEM-resident K+V inside budget at this sequence length
+    (long sequences shrink the group; the backward's scratch scales the
+    same way).  hg*d=512 blew the 16MB VMEM budget by 156KB at s=1024."""
+    def fits(hg):
+        return s * hg * d * 2 * 2 <= _RESIDENT_KV_BUDGET
+
     forced = os.getenv("PADDLE_TPU_FLASH_HEAD_GROUP")
     if forced:
         try:
@@ -84,100 +108,91 @@ def _pick_head_group(h: int, d: int):
                 return hg
         except ValueError:
             pass
-    # largest lane-aligned group with hg*d <= 256: amortizes grid overhead
-    # (measured +0.8k tokens/s over hg*d=128 on the 345M bench) while the
-    # backward's scratch stays inside the 16MB VMEM budget (hg*d=512
-    # overflowed by 156KB at s=1024)
-    for hg in (8, 4, 2, 1):
-        if h % hg == 0 and (hg * d) % 128 == 0 and hg * d <= 256:
+    groups = _aligned_groups(h, d)
+    for hg in groups:            # largest first
+        if hg * d <= 256 and fits(hg):
             return hg
-    for hg in (1, 2, 4, 8):
-        if h % hg == 0 and (hg * d) % 128 == 0:
-            return hg
-    return h
+    # nothing fits the VMEM budget: smallest aligned group is the best
+    # effort (supported() gates very long sequences off this path)
+    return groups[-1]
+
+
+def max_supported_seq(h: int, d: int) -> int:
+    """Longest sequence the forward can hold resident K+V for (used by
+    kernels.flash_attention.supported to gate dispatch)."""
+    hgd = _aligned_groups(h, d)[-1] * d
+    return (_RESIDENT_KV_BUDGET // (hgd * 4)) // 128 * 128
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
-                causal, scale, hg, d, nk):
-    # q/o: (1, BQ, HG*D); k/v: (1, BK, HG*D) — ki-th block, streamed by the
-    # grid; lse: (1, 1, HG, NQ, BQ); scratch m/l: (HG, BQ) f32,
-    # acc: (BQ, HG*D) f32, persistent across the sequential ki iterations.
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
+                d, block_k):
+    # q/o: (1, BQ, HG*D); k/v: (1, S, HG*D) — the WHOLE sequence resident
+    # in VMEM, scanned with a fori loop (measured faster than grid-streamed
+    # K/V blocks at these shapes: the pipeline only added grid overhead);
+    # lse: (1, 1, HG, NQ, BQ).
     block_q = q_ref.shape[1]
-    block_k = k_ref.shape[1]
+    s = k_ref.shape[1]
     qi = _pid(2)
-    ki = _pid(3)
 
-    @pl.when(ki == 0)
-    def _init():
-        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
-        l_sc[...] = jnp.zeros_like(l_sc)
-        acc_sc[...] = jnp.zeros_like(acc_sc)
+    row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
-    def _attend(masked):
-        if masked:
-            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = col_ids <= row_ids
-        for hh in range(hg):
-            sl = slice(hh * d, (hh + 1) * d)
-            q = q_ref[0, :, sl]                               # (BQ, D)
-            k = k_ref[0, :, sl]                               # (BK, D)
-            v = v_ref[0, :, sl]
-            # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
-            # operands first quarters matmul throughput
-            logits = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * jnp.float32(scale)
-            if masked:
-                logits = jnp.where(mask, logits, jnp.float32(_NEG_INF))
-            m = m_sc[hh]
-            new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
-            correction = jnp.exp(m - new_m)
-            p = jnp.exp(logits - new_m[:, None])
-            l_sc[hh] = l_sc[hh] * correction + jnp.sum(p, axis=-1)
-            acc_sc[:, sl] = acc_sc[:, sl] * correction[:, None] + \
-                jax.lax.dot_general(
+    for hh in range(hg):
+        sl = slice(hh * d, (hh + 1) * d)
+        q = q_ref[0, :, sl]                                   # (BQ, D)
+
+        def make_body(masked):
+            def body(kb, carry):
+                m, l, acc = carry
+                start = jax.lax.mul(kb, _i32(block_k))
+                k = k_ref[0, pl.ds(start, block_k), sl]
+                v = v_ref[0, pl.ds(start, block_k), sl]
+                # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
+                # operands first quarters matmul throughput
+                logits = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * jnp.float32(scale)
+                if masked:
+                    col_ids = start[None, None] + \
+                        jax.lax.broadcasted_iota(
+                            jnp.int32, (block_q, block_k), 1)
+                    logits = jnp.where(col_ids <= row_ids, logits,
+                                       jnp.float32(_NEG_INF))
+                new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
+                correction = jnp.exp(m - new_m)
+                p = jnp.exp(logits - new_m[:, None])
+                new_l = l * correction + jnp.sum(p, axis=-1)
+                new_acc = acc * correction[:, None] + jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            m_sc[hh] = new_m
+                return new_m, new_l, new_acc
+            return body
 
-    if causal:
-        # split visible blocks into fully-visible (no mask arithmetic —
-        # the iota/where VPU work is significant at these shapes) and the
-        # diagonal band (masked); the two pl.when branches are disjoint
-        first_row = jax.lax.mul(qi, _i32(block_q))
-        last_row = first_row + _i32(block_q - 1)
-        last_col = jax.lax.mul(ki, _i32(block_k)) + _i32(block_k - 1)
-        fully_visible = last_col <= first_row
-        diagonal = jnp.logical_and(last_col > first_row,
-                                   jax.lax.mul(ki, _i32(block_k)) <=
-                                   last_row)
-
-        @pl.when(fully_visible)
-        def _compute_full():
-            _attend(False)
-
-        @pl.when(diagonal)
-        def _compute_diag():
-            _attend(True)
-    else:
-        _attend(False)
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        for hh in range(hg):
-            sl = slice(hh * d, (hh + 1) * d)
-            l_safe = jnp.maximum(l_sc[hh], jnp.float32(1e-30))
-            o_ref[0, :, sl] = (acc_sc[:, sl] /
-                               l_safe[:, None]).astype(o_ref.dtype)
-            lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
-                (m_sc[hh] + jnp.log(l_safe))[None, :]
+        init = (jnp.full((block_q,), jnp.float32(_NEG_INF), jnp.float32),
+                jnp.zeros((block_q,), jnp.float32),
+                jnp.zeros((block_q, d), jnp.float32))
+        if causal:
+            # fully-visible blocks skip the mask arithmetic; the diagonal
+            # band (block_q // block_k blocks) applies it
+            assert block_q % block_k == 0
+            ratio = _i32(block_q // block_k)
+            num_full = jax.lax.mul(qi, ratio)
+            carry = jax.lax.fori_loop(_i32(0), num_full, make_body(False),
+                                      init)
+            m, l, acc = jax.lax.fori_loop(num_full,
+                                          jax.lax.add(num_full, ratio),
+                                          make_body(True), carry)
+        else:
+            m, l, acc = jax.lax.fori_loop(_i32(0), _i32(s // block_k),
+                                          make_body(False), init)
+        l_safe = jnp.maximum(l, jnp.float32(1e-30))
+        o_ref[0, :, sl] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
+            (m + jnp.log(l_safe))[None, :]
 
 
 def _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
@@ -195,31 +210,28 @@ def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
     sk = k3.shape[1]
     n_hg = hd // (hg * d)
     nq = s // block_q
-    nk = sk // block_k
     hgd = hg * d
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               hg=hg, d=d, nk=nk)
-    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i, j: (bi, i, g))
-    kv_spec = pl.BlockSpec((1, block_k, hgd), lambda bi, g, i, j: (bi, j, g))
+                               hg=hg, d=d, block_k=block_k)
+    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i: (bi, i, g))
+    kv_spec = pl.BlockSpec((1, sk, hgd), lambda bi, g, i: (bi, 0, g))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, n_hg, nq, nk),
+        grid=(b, n_hg, nq),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
             q_spec,
+            # whole folded lse slice per (b, head-group), revisited across
+            # the sequential q-block dim
             pl.BlockSpec((1, 1, hg, nq, block_q),
-                         lambda bi, g, i, j: (bi, g, 0, 0, 0)),
+                         lambda bi, g, i: (bi, g, 0, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
             jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((hg, block_q), jnp.float32),
-            pltpu.VMEM((hg, block_q), jnp.float32),
-            pltpu.VMEM((block_q, hgd), jnp.float32),
-        ],
-        compiler_params=_SEQ2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
     return out, lse
@@ -403,7 +415,7 @@ def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    hg = _pick_head_group(h, d)
+    hg = _pick_head_group(h, d, max(s, sk))
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     # shrink to the largest divisible block
@@ -411,6 +423,10 @@ def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
         block_q //= 2
     while block_k > 128 and sk % block_k:
         block_k //= 2
+    if causal and block_k > block_q:
+        # the causal scan splits the K loop at q-block granularity and
+        # needs block_q % block_k == 0 (both are powers of two)
+        block_k = block_q
     if s % block_q or sk % block_k:
         raise ValueError(
             "flash_attention: seq lengths (%d, %d) must be divisible by "

@@ -56,7 +56,8 @@ def test_flash_backward_matches_reference(causal, shape):
                                    atol=2e-4, rtol=1e-3, err_msg=name)
 
 
-@pytest.mark.parametrize("block_q,block_k", [(128, 128), (128, 64)])
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (128, 64),
+                                             (128, 256)])
 def test_flash_block_size_grid_edges(block_q, block_k):
     b, h, s, d = 1, 2, 256, 64
     rng = np.random.RandomState(2)
