@@ -1,0 +1,126 @@
+"""Pallas TPU fused softmax-cross-entropy (hard labels).
+
+The reference fuses softmax+CE in one CUDA kernel
+(paddle/phi/kernels/gpu/cross_entropy_kernel.cu); the XLA path here is two
+streaming reductions (max, sum-exp) plus a gather over the (N, V) logits —
+measured ~12 ms/step on the GPT-2 345M bench (V = 50304).  This kernel
+computes the row statistics, the label gather AND the loss in one pass over
+a VMEM-resident row tile, and the backward writes dlogits directly from the
+saved (m, lse) statistics:
+
+    nll_i  = lse_i - logits[i, y_i]
+    dlogits[i, v] = (exp(logits[i, v] - lse_i) - 1[v == y_i]) * g_i
+
+Gather-free: the label column is extracted with an iota==label masked sum
+(a VPU pass over the resident tile, no scalar loads).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+DEFAULT_BLOCK_ROWS = 8
+
+
+def supported(n_rows: int, vocab: int, block_rows: int = DEFAULT_BLOCK_ROWS):
+    """Tileability + VMEM budget for the resident (R, V) tile: the bf16
+    tile is double-buffered and the kernel's f32 elementwise chain
+    materialises ~3 tile-sized temporaries in VMEM."""
+    if n_rows <= 0 or vocab % 128 or n_rows % 8:
+        return False
+    br = _row_block(n_rows)
+    if n_rows % br:
+        return False
+    return br * vocab * (2 * 2 + 4 * 3) <= 10 * 1024 * 1024
+
+
+def _fwd_kernel(x_ref, y_ref, nll_ref, lse_ref):
+    x = x_ref[...].astype(jnp.float32)                   # (R, V)
+    y = y_ref[...][:, 0]                                 # (R,) i32
+    m = jnp.max(x, axis=-1)
+    e = jnp.exp(x - m[:, None])
+    lse = m + jnp.log(jnp.sum(e, axis=-1))
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    t = jnp.sum(jnp.where(cols == y[:, None], x, jnp.float32(0.0)), axis=-1)
+    nll_ref[...] = (lse - t)[:, None]
+    lse_ref[...] = lse[:, None]
+
+
+def _bwd_kernel(x_ref, y_ref, lse_ref, g_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)                   # (R, V)
+    y = y_ref[...][:, 0]
+    lse = lse_ref[...][:, 0]
+    g = g_ref[...][:, 0]
+    p = jnp.exp(x - lse[:, None])
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == y[:, None]).astype(jnp.float32)
+    dx_ref[...] = ((p - onehot) * g[:, None]).astype(dx_ref.dtype)
+
+
+def _row_block(n):
+    # DEFAULT_BLOCK_ROWS is the VMEM-bound maximum; with the n % 8 == 0
+    # gate this is currently always 8, but keep the shrink for future
+    # larger defaults
+    br = min(DEFAULT_BLOCK_ROWS, max(n, 1))
+    while br > 8 and n % br:
+        br //= 2
+    return br
+
+
+def _ce_fwd(x2, y2, interpret):
+    n, v = x2.shape
+    br = _row_block(n)
+    row = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    nll, lse = pl.pallas_call(
+        _fwd_kernel,
+        grid=(n // br,),
+        in_specs=[pl.BlockSpec((br, v), lambda i: (i, 0)), row],
+        out_specs=[row, row],
+        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32)] * 2,
+        interpret=interpret,
+    )(x2, y2)
+    return nll, lse
+
+
+def _ce_bwd(x2, y2, lse, g, interpret):
+    n, v = x2.shape
+    br = _row_block(n)
+    row = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=(n // br,),
+        in_specs=[pl.BlockSpec((br, v), lambda i: (i, 0)), row, row, row],
+        out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, v), x2.dtype),
+        interpret=interpret,
+    )(x2, y2, lse, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_ce_pallas(logits2, labels2, interpret=False):
+    """logits2: (N, V); labels2: (N, 1) int32 (pre-clipped to [0, V)).
+    Returns per-row nll (N,) f32."""
+    with jax.enable_x64(False):
+        nll, _ = _ce_fwd(logits2, labels2, interpret)
+    return nll[:, 0]
+
+
+def _vjp_fwd(logits2, labels2, interpret):
+    with jax.enable_x64(False):
+        nll, lse = _ce_fwd(logits2, labels2, interpret)
+    return nll[:, 0], (logits2, labels2, lse)
+
+
+def _vjp_bwd(interpret, res, g):
+    logits2, labels2, lse = res
+    with jax.enable_x64(False):
+        dx = _ce_bwd(logits2, labels2, lse,
+                     g.astype(jnp.float32)[:, None], interpret)
+    return dx, None
+
+
+softmax_ce_pallas.defvjp(_vjp_fwd, _vjp_bwd)

@@ -75,11 +75,16 @@ def _pid(i):
     return jax.lax.convert_element_type(pl.program_id(i), jnp.int32)
 
 
-# VMEM spent on the forward's resident K+V per grid cell is
-# s * hg*d * 2 arrays * 2 B (bf16), double-buffered by the pipeline;
-# keep it under this budget (of the ~16MB per-core VMEM) so the q block,
-# logits and accumulators still fit.
+# VMEM budget for the forward's resident K+V per grid cell
+# (s * hg*d * 2 arrays * 2 B bf16, double-buffered by the pipeline);
+# sequences whose K/V exceed it take the grid-streamed forward instead.
 _RESIDENT_KV_BUDGET = 4 * 1024 * 1024
+# VMEM budget for the backward's full-sequence dq accumulator
+# (s * hg*d * 4 B f32) — THE sequence-length bound of the Pallas path;
+# beyond it the sequence axis must shard (ring attention, SURVEY §5.7).
+# 4MB empirically: 8MB of dq scratch plus streamed blocks + dk/dv scratch
+# + lse/delta overflowed the 16MB VMEM by 4.5MB at s=8192.
+_DQ_SCRATCH_BUDGET = 4 * 1024 * 1024
 
 
 def _aligned_groups(h: int, d: int):
@@ -93,12 +98,11 @@ def _aligned_groups(h: int, d: int):
 def _pick_head_group(h: int, d: int, s: int):
     """Heads per grid cell: hg*d must be lane-aligned (%128) and divide h.
     Picks the LARGEST group with hg*d <= 256 — bigger groups amortize grid
-    overhead (+0.8k tokens/s measured on the 345M bench) — that also keeps
-    the forward's VMEM-resident K+V inside budget at this sequence length
-    (long sequences shrink the group; the backward's scratch scales the
-    same way).  hg*d=512 blew the 16MB VMEM budget by 156KB at s=1024."""
-    def fits(hg):
-        return s * hg * d * 2 * 2 <= _RESIDENT_KV_BUDGET
+    overhead (+0.8k tokens/s measured on the 345M bench; hg*d=512 blew
+    VMEM by 156KB at s=1024) — whose backward dq scratch still fits at this
+    sequence length (long sequences shrink the group)."""
+    def bwd_fits(hg):
+        return s * hg * d * 4 <= _DQ_SCRATCH_BUDGET
 
     forced = os.getenv("PADDLE_TPU_FLASH_HEAD_GROUP")
     if forced:
@@ -110,18 +114,20 @@ def _pick_head_group(h: int, d: int, s: int):
             pass
     groups = _aligned_groups(h, d)
     for hg in groups:            # largest first
-        if hg * d <= 256 and fits(hg):
+        if hg * d <= 256 and bwd_fits(hg):
             return hg
-    # nothing fits the VMEM budget: smallest aligned group is the best
-    # effort (supported() gates very long sequences off this path)
+    # nothing fits: smallest aligned group is the best effort
+    # (supported() gates longer sequences off this path entirely)
     return groups[-1]
 
 
 def max_supported_seq(h: int, d: int) -> int:
-    """Longest sequence the forward can hold resident K+V for (used by
-    kernels.flash_attention.supported to gate dispatch)."""
+    """Longest sequence the Pallas path supports end-to-end — bounded by
+    the backward's full-sequence dq scratch at the smallest aligned head
+    group (the forward streams K/V blocks for long sequences, so it is not
+    the binding constraint).  Used by kernels.flash_attention.supported."""
     hgd = _aligned_groups(h, d)[-1] * d
-    return (_RESIDENT_KV_BUDGET // (hgd * 4)) // 128 * 128
+    return (_DQ_SCRATCH_BUDGET // (hgd * 4)) // 128 * 128
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +201,86 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
             (m + jnp.log(l_safe))[None, :]
 
 
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
+                causal, scale, hg, d, nk):
+    # q/o: (1, BQ, HG*D); k/v: (1, BK, HG*D) — ki-th block, streamed by the
+    # grid; lse: (1, 1, HG, NQ, BQ); scratch m/l: (HG, BQ) f32,
+    # acc: (BQ, HG*D) f32, persistent across the sequential ki iterations.
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = _pid(2)
+    ki = _pid(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    def _attend(masked):
+        if masked:
+            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = col_ids <= row_ids
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0, :, sl]                               # (BQ, D)
+            k = k_ref[0, :, sl]                               # (BK, D)
+            v = v_ref[0, :, sl]
+            # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
+            # operands first quarters matmul throughput
+            logits = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            if masked:
+                logits = jnp.where(mask, logits, jnp.float32(_NEG_INF))
+            m = m_sc[hh]
+            new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
+            correction = jnp.exp(m - new_m)
+            p = jnp.exp(logits - new_m[:, None])
+            l_sc[hh] = l_sc[hh] * correction + jnp.sum(p, axis=-1)
+            acc_sc[:, sl] = acc_sc[:, sl] * correction[:, None] + \
+                jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_sc[hh] = new_m
+
+    if causal:
+        # split visible blocks into fully-visible (no mask arithmetic —
+        # the iota/where VPU work is significant at these shapes) and the
+        # diagonal band (masked); the two pl.when branches are disjoint
+        first_row = jax.lax.mul(qi, _i32(block_q))
+        last_row = first_row + _i32(block_q - 1)
+        last_col = jax.lax.mul(ki, _i32(block_k)) + _i32(block_k - 1)
+        fully_visible = last_col <= first_row
+        diagonal = jnp.logical_and(last_col > first_row,
+                                   jax.lax.mul(ki, _i32(block_k)) <=
+                                   last_row)
+
+        @pl.when(fully_visible)
+        def _compute_full():
+            _attend(False)
+
+        @pl.when(diagonal)
+        def _compute_diag():
+            _attend(True)
+    else:
+        _attend(False)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            l_safe = jnp.maximum(l_sc[hh], jnp.float32(1e-30))
+            o_ref[0, :, sl] = (acc_sc[:, sl] /
+                               l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
+                (m_sc[hh] + jnp.log(l_safe))[None, :]
+
+
+
 def _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
                interpret=False):
     # trace with x64 off: the global x64 mode (needed for paddle's int64
@@ -210,28 +296,56 @@ def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
     sk = k3.shape[1]
     n_hg = hd // (hg * d)
     nq = s // block_q
+    nk = sk // block_k
     hgd = hg * d
-    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               hg=hg, d=d, block_k=block_k)
-    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i: (bi, i, g))
-    kv_spec = pl.BlockSpec((1, sk, hgd), lambda bi, g, i: (bi, 0, g))
+    q_spec3 = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i: (bi, i, g))
+    lse_shape = jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32)
+    out_shape = jax.ShapeDtypeStruct((b, s, hd), q3.dtype)
+    if sk * hgd * 2 * 2 <= _RESIDENT_KV_BUDGET:
+        # fast path: whole K/V resident per cell, fori scan (measured
+        # fastest at bench shapes)
+        kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                                   hg=hg, d=d, block_k=block_k)
+        kv_spec = pl.BlockSpec((1, sk, hgd), lambda bi, g, i: (bi, 0, g))
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b, n_hg, nq),
+            in_specs=[q_spec3, kv_spec, kv_spec],
+            out_specs=[
+                q_spec3,
+                # whole folded lse slice per (b, head-group), revisited
+                # across the sequential q-block dim
+                pl.BlockSpec((1, 1, hg, nq, block_q),
+                             lambda bi, g, i: (bi, g, 0, 0, 0)),
+            ],
+            out_shape=[out_shape, lse_shape],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(q3, k3, v3)
+        return out, lse
+    # long-sequence path: K/V blocks streamed by the grid — O(block) VMEM,
+    # keeps the O(S) capability for sequences whose K/V don't fit resident
+    kernel = functools.partial(_fwd_kernel_streamed, causal=causal,
+                               scale=scale, hg=hg, d=d, nk=nk)
+    q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i, j: (bi, i, g))
+    kv_spec = pl.BlockSpec((1, block_k, hgd), lambda bi, g, i, j: (bi, j, g))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, n_hg, nq),
+        grid=(b, n_hg, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
             q_spec,
-            # whole folded lse slice per (b, head-group), revisited across
-            # the sequential q-block dim
             pl.BlockSpec((1, 1, hg, nq, block_q),
-                         lambda bi, g, i: (bi, g, 0, 0, 0)),
+                         lambda bi, g, i, j: (bi, g, 0, 0, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
-            jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32),
+        out_shape=[out_shape, lse_shape],
+        scratch_shapes=[
+            pltpu.VMEM((hg, block_q), jnp.float32),
+            pltpu.VMEM((hg, block_q), jnp.float32),
+            pltpu.VMEM((block_q, hgd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_SEQ2,
         interpret=interpret,
     )(q3, k3, v3)
     return out, lse

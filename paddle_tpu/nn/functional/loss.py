@@ -13,6 +13,40 @@ import jax.numpy as jnp
 from ...core.dispatch import call, wrap_op
 
 
+def _fused_ce_or_none(logits, lbl, ignore_index):
+    """Opt-in route (FLAGS_use_pallas_ce=1) to the Pallas fused softmax-CE
+    kernel.  Default stays XLA: the streaming-reduction path measured
+    FASTER on the 345M bench (49.7k vs 49.1k tokens/s) — the VMEM budget
+    caps the kernel at 8-row tiles whose grid overhead outweighs the fused
+    gather.  The kernel remains the escape hatch for shapes where XLA's
+    reduction fusion misbehaves.  Returns None to take the XLA path."""
+    from ...utils.flags import fast_get
+    if not fast_get("use_pallas_ce"):
+        return None
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return None
+    if backend != "tpu":
+        return None
+    from ...kernels import ce_pallas
+    v = logits.shape[-1]
+    lead = logits.shape[:-1]
+    n = 1
+    for dim in lead:
+        n *= dim
+    if not ce_pallas.supported(n, v):
+        return None
+    # index math under x64-off: s64 labels would otherwise put emulated
+    # 64-bit clamp/convert ops into the program (tests/test_x64_audit.py)
+    with jax.enable_x64(False):
+        idx = jnp.clip(lbl.astype(jnp.int32), 0, v - 1).reshape(n, 1)
+        nll = ce_pallas.softmax_ce_pallas(logits.reshape(n, v), idx)
+    nll = nll.reshape(lead)
+    mask = (lbl != ignore_index)
+    return jnp.where(mask, nll, 0.0)
+
+
 def _reduce(out, reduction, weight_sum=None):
     if reduction == "mean":
         if weight_sum is not None:
@@ -37,6 +71,10 @@ def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
     lbl = label
     if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
         lbl = jnp.squeeze(lbl, axis)
+    if axis in (-1, logits.ndim - 1):
+        out = _fused_ce_or_none(logits, lbl, ignore_index)
+        if out is not None:
+            return out
     lf = logits.astype(jnp.float32)
     m = jax.lax.stop_gradient(jnp.max(lf, axis=axis))
     lse = m + jnp.log(jnp.sum(jnp.exp(lf - jnp.expand_dims(m, axis)),

@@ -62,6 +62,10 @@ define_flag("use_flash_attention", True, "route attention through Pallas")
 define_flag("use_pallas_norm", False,
             "route layer_norm through the Pallas kernel (XLA's fused LN is "
             "already at peak; opt-in escape hatch)")
+define_flag("use_pallas_ce", False,
+            "route hard-label cross_entropy through the fused Pallas "
+            "softmax-CE kernel (XLA's streaming path measured faster on "
+            "the 345M bench; opt-in escape hatch)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")

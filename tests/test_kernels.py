@@ -56,16 +56,41 @@ def test_flash_backward_matches_reference(causal, shape):
                                    atol=2e-4, rtol=1e-3, err_msg=name)
 
 
+@pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("block_q,block_k", [(128, 128), (128, 64),
                                              (128, 256)])
-def test_flash_block_size_grid_edges(block_q, block_k):
+def test_flash_block_size_grid_edges(block_q, block_k, causal):
+    # (128, 256) only stays wide-K on the non-causal path (causal clamps
+    # block_k to block_q); both variants must match the reference
     b, h, s, d = 1, 2, 256, 64
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
-    out = flash_attention_bhsd(q, k, v, causal=True, block_q=block_q,
+    out = flash_attention_bhsd(q, k, v, causal=causal, block_q=block_q,
                                block_k=block_k, interpret=True)
+    ref = _reference_bhsd(q, k, v, causal, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_streamed_long_seq_path():
+    """Sequences whose K/V exceed the resident budget take the
+    grid-streamed forward — same numerics (checked in interpret mode with
+    a tiny budget override)."""
+    import paddle_tpu.kernels.flash_attention_pallas as fp
+    b, h, s, d = 1, 2, 512, 64
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    old = fp._RESIDENT_KV_BUDGET
+    fp._RESIDENT_KV_BUDGET = 1  # force the streamed path
+    try:
+        out = flash_attention_bhsd(q, k, v, causal=True, block_q=128,
+                                   block_k=128, interpret=True)
+    finally:
+        fp._RESIDENT_KV_BUDGET = old
     ref = _reference_bhsd(q, k, v, True, 1.0 / d ** 0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
