@@ -76,6 +76,10 @@ def _safe_process_count():
 _initialized = [False]
 
 
+def _jax_distributed_initialized() -> bool:
+    return bool(jax.distributed.is_initialized())
+
+
 def init_parallel_env(backend=None, mesh_axes: Optional[Dict[str, int]] = None):
     """reference parity: parallel.py:91.
 
@@ -88,7 +92,11 @@ def init_parallel_env(backend=None, mesh_axes: Optional[Dict[str, int]] = None):
         return ParallelEnv()
     master = os.getenv("PADDLE_MASTER") or os.getenv("MASTER_ADDR")
     nprocs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
-    if master and nprocs > 1:
+    if master and nprocs > 1 and not _jax_distributed_initialized():
+        # NOTE: jax.distributed.initialize must run before the XLA backend
+        # initializes; if anything touched jax first, call
+        # jax.distributed.initialize(...) at the very top of the worker
+        # (see tests/test_multiprocess_dp.py) — this branch then skips.
         port = os.getenv("MASTER_PORT")
         addr = master if ":" in master or not port else f"{master}:{port}"
         jax.distributed.initialize(

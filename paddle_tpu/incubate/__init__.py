@@ -1,4 +1,5 @@
 """paddle_tpu.incubate (reference surface: python/paddle/incubate/)."""
+from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

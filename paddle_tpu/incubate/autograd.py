@@ -1,0 +1,26 @@
+"""paddle.incubate.autograd (reference: python/paddle/incubate/autograd/ —
+functional vjp/jvp/Jacobian/Hessian primitives).
+
+The stable ``paddle.autograd`` package already carries the functional
+transforms (they are jax-native here); this module is the incubate-path
+alias the reference exposes, plus prim-mode shims (`enable_prim` — on TPU
+every trace is already "primitive mode": jax primitives + XLA)."""
+from __future__ import annotations
+
+from ..autograd import Hessian, Jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled"]
+
+
+def enable_prim():
+    """No-op: jax traces ARE the primitive graph (the reference lowers ops
+    to autodiff primitives to do what jax.vjp/jvp do natively)."""
+
+
+def disable_prim():
+    """No-op (see enable_prim)."""
+
+
+def prim_enabled() -> bool:
+    return True

@@ -164,3 +164,20 @@ def test_flops_dtypes_and_mode_restore():
     with pytest.raises(NotImplementedError):
         paddle.flops(net, input_size=[2, 16], dtypes="int32",
                      custom_ops={object: None})
+
+
+def test_op_schema_in_sync():
+    """ops_schema.yaml is generated from the live surface; CI keeps it in
+    sync (the reference's yaml->codegen invariant, inverted — N13)."""
+    import os
+    from paddle_tpu.ops.schema import _to_yaml, generate_schema
+    schema = generate_schema()
+    assert len(schema) >= 300
+    # every op has a name and params list
+    for op in schema[:20]:
+        assert op["name"] and isinstance(op["params"], list)
+    path = os.path.join(os.path.dirname(__file__), "..", "ops_schema.yaml")
+    committed = open(os.path.abspath(path)).read()
+    assert committed == _to_yaml(schema), (
+        "ops_schema.yaml is stale — regenerate with "
+        "`python -m paddle_tpu.ops.schema`")
