@@ -104,14 +104,9 @@ def _pick_head_group(h: int, d: int, s: int):
     def bwd_fits(hg):
         return s * hg * d * 4 <= _DQ_SCRATCH_BUDGET
 
-    forced = os.getenv("PADDLE_TPU_FLASH_HEAD_GROUP")
-    if forced:
-        try:
-            hg = int(forced)
-            if h % hg == 0 and ((hg * d) % 128 == 0 or hg == h):
-                return hg
-        except ValueError:
-            pass
+    forced = _valid_forced_group(h, d)
+    if forced is not None:
+        return forced
     groups = _aligned_groups(h, d)
     for hg in groups:            # largest first
         if hg * d <= 256 and bwd_fits(hg):
@@ -119,6 +114,41 @@ def _pick_head_group(h: int, d: int, s: int):
     # nothing fits: smallest aligned group is the best effort
     # (supported() gates longer sequences off this path entirely)
     return groups[-1]
+
+
+def _kv_fits_resident(s: int, hgd: int) -> bool:
+    """K+V bf16, double-buffered — must match _flash_fwd_inner's dispatch
+    between the resident and streamed forward."""
+    return s * hgd * 2 * 2 <= _RESIDENT_KV_BUDGET
+
+
+def _valid_forced_group(h: int, d: int):
+    raw = os.getenv("PADDLE_TPU_FLASH_HEAD_GROUP")
+    if not raw:
+        return None
+    try:
+        hg = int(raw)
+    except ValueError:
+        return None
+    if h % hg == 0 and ((hg * d) % 128 == 0 or hg == h):
+        return hg
+    return None
+
+
+def _pick_fwd_head_group(h: int, d: int, s: int, hg_b: int) -> int:
+    """The forward has no full-sequence scratch, so it can afford a larger
+    group (up to hg*d = 512) when the resident K/V still fits — fewer grid
+    cells amortize per-cell overhead.  Falls back to the backward's group.
+    A VALID env override (PADDLE_TPU_FLASH_HEAD_GROUP) pins both
+    directions; invalid values are ignored in both pickers."""
+    if _valid_forced_group(h, d) is not None:
+        return hg_b
+    for hg in _aligned_groups(h, d):      # largest first
+        if hg * d <= 512 and _kv_fits_resident(s, hg * d):
+            # the first admissible candidate is always >= hg_b (hg_b
+            # satisfies stricter constraints), so no max() needed
+            return hg
+    return hg_b
 
 
 def max_supported_seq(h: int, d: int) -> int:
@@ -301,7 +331,7 @@ def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
     q_spec3 = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i: (bi, i, g))
     lse_shape = jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32)
     out_shape = jax.ShapeDtypeStruct((b, s, hd), q3.dtype)
-    if sk * hgd * 2 * 2 <= _RESIDENT_KV_BUDGET:
+    if _kv_fits_resident(sk, hgd):
         # fast path: whole K/V resident per cell, fori scan (measured
         # fastest at bench shapes)
         kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
@@ -498,24 +528,37 @@ def _reference_bhsd(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q3, k3, v3, causal, scale, block_q, block_k, hg, d, interpret):
-    out, _ = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
-                        interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q3, k3, v3, causal, scale, block_q, block_k, hg_f, hg_b, d,
+           interpret):
+    # hg_f / hg_b: independent head groups for forward and backward — the
+    # backward's full-sequence dq scratch binds its group size, while the
+    # forward can amortize more heads per grid cell
+    out, _ = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg_f,
+                        d, interpret)
     return out
 
 
-def _flash_vjp_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
-                   interpret):
-    out, lse = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg,
+def _flash_vjp_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg_f, hg_b,
+                   d, interpret):
+    out, lse = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg_f,
                           d, interpret)
     return out, (q3, k3, v3, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, hg, d, interpret, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, hg_f, hg_b, d,
+                   interpret, res, g):
     q3, k3, v3, out, lse = res
+    if hg_b != hg_f:
+        # regroup the folded lse rows (b, h/hg_f, hg_f, nq, bq) ->
+        # (b, h/hg_b, hg_b, nq, bq): contiguous reshape, no data movement
+        b = lse.shape[0]
+        nq, bq = lse.shape[3], lse.shape[4]
+        h = lse.shape[1] * lse.shape[2]
+        lse = lse.reshape(b, h // hg_b, hg_b, nq, bq)
     return _flash_bwd(q3, k3, v3, out, lse, g, causal, scale, block_q,
-                      block_k, hg, d, interpret)
+                      block_k, hg_b, d, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -529,7 +572,8 @@ def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    hg = _pick_head_group(h, d, max(s, sk))
+    hg_b = _pick_head_group(h, d, max(s, sk))
+    hg_f = _pick_fwd_head_group(h, d, max(s, sk), hg_b)
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     # shrink to the largest divisible block
@@ -550,8 +594,8 @@ def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
     q3 = q.reshape(b, s, h * d)
     k3 = k.reshape(b, sk, h * d)
     v3 = v.reshape(b, sk, h * d)
-    out = _flash(q3, k3, v3, causal, float(scale), block_q, block_k, hg, d,
-                 interpret)
+    out = _flash(q3, k3, v3, causal, float(scale), block_q, block_k, hg_f,
+                 hg_b, d, interpret)
     return out.reshape(b, s, h, d)
 
 
