@@ -693,6 +693,29 @@ SPECS = [
     Spec("increment", fmat(1), lambda x: x + 1, bf16=False),
     Spec("clone", fmat(3, 4), lambda x: x, grad=(0,)),
     Spec("assign", fmat(3, 4), lambda x: x),
+    # -- round-2 surface additions (ops/extras.py, ops/linalg.py) ---------
+    Spec("logit", fmat(3, 4, lo=0.1, hi=0.9),
+         lambda x: np.log(x / (1 - x)), grad=(0,)),
+    Spec("diagonal", fmat(4, 4), lambda x: np.diagonal(x), grad=(0,)),
+    Spec("add_n", lambda: ([RNG.randn(3, 4).astype(np.float32),
+                            RNG.randn(3, 4).astype(np.float32)], {}),
+         lambda a, b: a + b,
+         fn=lambda a, b: __import__("paddle_tpu").add_n([a, b]),
+         bf16=False),
+    Spec("renorm", fmat(3, 4),
+         lambda x: x * np.minimum(
+             1.0, 1.0 / np.maximum(
+                 np.sqrt((x ** 2).sum(1, keepdims=True)), 1e-12)),
+         fn=lambda x: __import__("paddle_tpu").renorm(x, p=2.0, axis=0,
+                                                      max_norm=1.0),
+         bf16=False),
+    Spec("sequence_mask",
+         lambda: ([np.array([1, 3, 2], np.int64)], {"maxlen": 4}),
+         lambda x, maxlen=4: (np.arange(4)[None, :] <
+                              x[:, None]).astype(np.int64),
+         fn=lambda x, maxlen: __import__(
+             "paddle_tpu").nn.functional.sequence_mask(x, maxlen=maxlen),
+         bf16=False),
 ]
 
 
