@@ -53,7 +53,11 @@ def call(raw_fn: Callable, *args, name: str = None, **kwargs):
 
     if not diff_idx:
         a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
-        out = raw_fn(*a2, **k2)
+        try:
+            out = raw_fn(*a2, **k2)
+        except Exception as e:
+            _annotate_op_error(e, name, arrays)
+            raise
         return _wrap_outputs(out, None, op_name=name)
 
     diff_arrays = [arrays[i] for i in diff_idx]
@@ -65,7 +69,11 @@ def call(raw_fn: Callable, *args, name: str = None, **kwargs):
         a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
         return raw_fn(*a2, **k2)
 
-    out, vjp_fn = jax.vjp(f, *diff_arrays)
+    try:
+        out, vjp_fn = jax.vjp(f, *diff_arrays)
+    except Exception as e:
+        _annotate_op_error(e, name, arrays)
+        raise
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     node = GradNode(
@@ -76,6 +84,23 @@ def call(raw_fn: Callable, *args, name: str = None, **kwargs):
         out_treedef=out_treedef,
     )
     return _wrap_outputs(out, node, op_name=name)
+
+
+def _annotate_op_error(e: BaseException, name, arrays):
+    """Rich error context (reference: PADDLE_ENFORCE op-attributed errors,
+    phi/core/enforce.h): attach the failing operator and its input
+    shapes/dtypes to the exception without altering its type."""
+    try:
+        shapes = ", ".join(
+            f"{tuple(a.shape)}:{a.dtype}" if hasattr(a, "shape") else
+            repr(a)[:32]
+            for a in arrays[:6])
+        if len(arrays) > 6:
+            shapes += f", +{len(arrays) - 6} more"
+        e.add_note(f"[paddle_tpu] operator: {name or '<unnamed>'} "
+                   f"(inputs: {shapes})")
+    except Exception:
+        pass  # never mask the original error
 
 
 def _wrap_outputs(out, node, op_name=None):

@@ -168,3 +168,18 @@ def test_comparison_returns_bool():
     b = paddle.to_tensor([2.0, 2.0])
     assert (a == b).dtype == np.bool_
     np.testing.assert_array_equal((a < b).numpy(), [True, False])
+
+
+def test_op_errors_carry_operator_context():
+    """Exceptions from ops are annotated with the operator name and input
+    shapes (the PADDLE_ENFORCE rich-error contract, N31)."""
+    import paddle_tpu as paddle
+    a = paddle.to_tensor([[1.0, 2.0]])
+    b = paddle.to_tensor([[1.0], [2.0], [3.0]])
+    try:
+        paddle.matmul(a, b)   # (1,2) @ (3,1): dimension mismatch
+        assert False, "expected a shape error"
+    except Exception as e:
+        note = "".join(getattr(e, "__notes__", []))
+        assert "operator: matmul" in note, note
+        assert "(1, 2)" in note
