@@ -1,4 +1,9 @@
 """Model zoo: language models (GPT-2 flagship) + vision re-exports."""
-from ..vision.models import (LeNet, ResNet, resnet18, resnet50)  # noqa: F401
+from ..vision.models import (DenseNet, GoogLeNet, InceptionV3,  # noqa: F401
+                             LeNet, MobileNetV3Large, MobileNetV3Small,
+                             ResNet, ShuffleNetV2, SqueezeNet, densenet121,
+                             googlenet, inception_v3, mobilenet_v3_large,
+                             mobilenet_v3_small, resnet18, resnet50,
+                             shufflenet_v2_x1_0, squeezenet1_1)
 from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,  # noqa: F401
                   GPTPretrainingCriterion, gpt2_345m)
