@@ -79,21 +79,68 @@ class _nullcontext:
 
 class StaticFunction:
     """Compiled wrapper around a Layer or function
-    (reference: program_translator.py:236 StaticFunction)."""
+    (reference: program_translator.py:236 StaticFunction).
+
+    Before jitting, the target's source is run through the dy2static AST
+    pass (jit/dy2static.py — reference ast_transformer.py) so data-dependent
+    Python ``if``/``while`` lower to lax.cond/while_loop instead of raising
+    a tracer error.  Unsupported control-flow shapes fall back to trace-only
+    compilation; the reason is kept on ``_dy2static_error``."""
 
     def __init__(self, target, input_spec=None, build_strategy=None,
                  backend=None):
+        from .dy2static import Dy2StaticUnsupportedError, transform_function
+
         self._target = target
         self._input_spec = input_spec
         self._is_layer = isinstance(target, Layer)
+        self._dy2static_error = None
+        self._forward_override = None   # transformed forward, NOT written
+        try:                            # onto the user's eager layer
+            if self._is_layer:
+                tf = transform_function(type(target).forward)
+                if getattr(tf, "__dy2static_transformed__", False):
+                    self._forward_override = tf
+            else:
+                tf = transform_function(target)
+                if getattr(tf, "__dy2static_transformed__", False):
+                    self._target = tf
+        except Dy2StaticUnsupportedError as e:
+            self._dy2static_error = e
         if self._is_layer:
             self._jitted = jax.jit(self._layer_core)
         else:
             self._jitted = jax.jit(self._fn_core)
 
+    def _override_ctx(self):
+        """Apply the dy2static-converted forward to the layer for the
+        duration of a traced call only — the user's eager object stays
+        untouched (a permanent rebind would silently change eager behavior
+        and freeze closure nonlocals)."""
+        import contextlib
+        import types as _types
+
+        if self._forward_override is None or not self._is_layer:
+            return _nullcontext()
+
+        @contextlib.contextmanager
+        def ctx():
+            old = self._target.__dict__.get("forward")
+            self._target.__dict__["forward"] = _types.MethodType(
+                self._forward_override, self._target)
+            try:
+                yield
+            finally:
+                if old is None:
+                    self._target.__dict__.pop("forward", None)
+                else:
+                    self._target.__dict__["forward"] = old
+        return ctx()
+
     def _layer_core(self, state, rng, args, kwargs):
-        out, new_state = functional_call(self._target, state, *args,
-                                         rng=rng, **kwargs)
+        with self._override_ctx():
+            out, new_state = functional_call(self._target, state, *args,
+                                             rng=rng, **kwargs)
         return out, new_state
 
     def _fn_core(self, rng, args, kwargs):
@@ -156,8 +203,11 @@ def save(layer, path, input_spec=None, **config):
     if input_spec is None:
         raise ValueError("jit.save needs input_spec=[InputSpec(...), ...] "
                          "(shapes are static under XLA)")
-    return save_inference_model(path, model=target, input_spec=input_spec,
-                                **config)
+    ctx = (layer._override_ctx() if isinstance(layer, StaticFunction)
+           else _nullcontext())
+    with ctx:
+        return save_inference_model(path, model=target,
+                                    input_spec=input_spec, **config)
 
 
 class TranslatedLayer(Layer):
@@ -219,6 +269,21 @@ class TrainStep:
                        if k in trainable}
         self.buffers = {k: copy(v) for k, v in full_state.items()
                         if k not in trainable}
+        # AMP O2: a low-precision trainable param is held as ONE fp32
+        # master array in the step state and cast to its compute dtype
+        # inside the compiled step (so the optimizer never creates a
+        # separate "master" slot).  Keeping both a bf16 param and an fp32
+        # master in the step I/O round-trips every parameter through HBM
+        # twice per step — neither buffer can donation-alias the other —
+        # measured ~15 ms/step of pure copies on the GPT-2 345M bench
+        # (PERF.md "copy lane").
+        self._compute_dtypes = {}
+        if getattr(optimizer, "_multi_precision", None) is not False:
+            for k, v in list(self.params.items()):
+                if hasattr(v, "dtype") and v.dtype in (jnp.bfloat16,
+                                                       jnp.float16):
+                    self._compute_dtypes[k] = v.dtype
+                    self.params[k] = v.astype(jnp.float32)
         self.opt_state = optimizer.init_state(self.params)
         self._dirty = True
 
@@ -278,6 +343,12 @@ class TrainStep:
             self._mesh = None
 
         def loss_core(params, buffers, rng, batch):
+            if self._compute_dtypes:
+                # fp32 master -> compute dtype; the cast's vjp upcasts the
+                # bf16 grads back to f32 for the optimizer update
+                params = {k: (p.astype(self._compute_dtypes[k])
+                              if k in self._compute_dtypes else p)
+                          for k, p in params.items()}
             state = {**params, **buffers}
             self.model.train()
             inputs = batch[:self.num_inputs]
@@ -296,7 +367,7 @@ class TrainStep:
             new_buffers = {k: new_state[k] for k in buffers.keys()}
             return loss, new_buffers
 
-        def step_fn(params, buffers, opt_state, lr, rng, batch):
+        def grads_core(params, buffers, rng, batch):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_core, has_aux=True)(params, buffers, rng, batch)
             if self._grad_specs is not None:
@@ -308,6 +379,15 @@ class TrainStep:
                     k: jax.lax.with_sharding_constraint(
                         g, NamedSharding(self._mesh, self._grad_specs[k]))
                     for k, g in grads.items()}
+            return loss, new_buffers, grads
+
+        # exposed for tests/diagnostics: the exact grad computation the
+        # compiled step runs, including ZeRO layout constraints
+        self._grads_core = grads_core
+
+        def step_fn(params, buffers, opt_state, lr, rng, batch):
+            loss, new_buffers, grads = grads_core(params, buffers, rng,
+                                                  batch)
             new_params, new_opt_state = self.optimizer.apply_gradients(
                 params, grads, opt_state, lr)
             if self._param_specs is not None:
@@ -347,7 +427,10 @@ class TrainStep:
 
     def sync_to_model(self):
         """Write the trained arrays back into the eager model."""
-        self.model.load_functional_state({**self.params, **self.buffers})
+        params = {k: (v.astype(self._compute_dtypes[k])
+                      if k in self._compute_dtypes else v)
+                  for k, v in self.params.items()}
+        self.model.load_functional_state({**params, **self.buffers})
         self._dirty = False
 
     # -- checkpoint contract (incubate.checkpoint) -------------------------
@@ -366,7 +449,12 @@ class TrainStep:
         their current shardings (ZeRO layouts survive a restore)."""
         def place_like(new, old):
             if hasattr(old, "sharding") and hasattr(new, "shape"):
-                return jax.device_put(jnp.asarray(new), old.sharding)
+                arr = jnp.asarray(new)
+                if hasattr(old, "dtype") and arr.dtype != old.dtype:
+                    # e.g. a bf16 model-side save restored into the fp32
+                    # master param state
+                    arr = arr.astype(old.dtype)
+                return jax.device_put(arr, old.sharding)
             return new
         self.params = {k: place_like(v, self.params.get(k))
                        for k, v in state["params"].items()}

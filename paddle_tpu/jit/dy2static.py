@@ -1,0 +1,382 @@
+"""dy2static: AST-level conversion of data-dependent Python control flow.
+
+The reference converts a dygraph forward into a static program through ~15
+AST transformers (fluid/dygraph/dygraph_to_static/ast_transformer.py,
+ifelse_transformer.py, loop_transformer.py) whose output calls runtime
+dispatchers (convert_operators.py: convert_ifelse, convert_while_loop) that
+pick the tensor path (cond/while ops) or the plain Python path per call.
+
+TPU-native rendering: the same two-phase design — an ``ast.NodeTransformer``
+rewrites ``if``/``while`` statements in the forward source into calls to
+:func:`convert_ifelse` / :func:`convert_while`, which dispatch on whether
+the predicate is a traced value: under ``jax.jit`` tracing they lower to
+``lax.cond`` / ``lax.while_loop``; called eagerly they run plain Python.
+
+Supported rewrites (anything else raises Dy2StaticUnsupportedError at
+transform time, and ``to_static`` falls back to trace-only compilation —
+data-INdependent control flow needs no rewrite under jax tracing anyway):
+
+* ``if``/``elif``/``else`` whose branches only ASSIGN variables: branch
+  bodies become local functions over the assigned names (both-branch merge
+  semantics; a variable read after the ``if`` must be bound on every path).
+* ``if``/``else`` whose branches both END in ``return``: rewritten to
+  ``return convert_ifelse(...)``.
+* ``while`` whose body assigns previously-bound names: loop-carried
+  variables are every name assigned in the body that is bound before the
+  loop; ``break``/``continue``/``return`` inside are not supported.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_ifelse", "convert_while", "convert_bool",
+           "transform_function", "Dy2StaticUnsupportedError"]
+
+
+class Dy2StaticUnsupportedError(Exception):
+    """A control-flow shape the converter does not rewrite."""
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatchers (reference: dygraph_to_static/convert_operators.py)
+# ---------------------------------------------------------------------------
+
+class _Undefined:
+    """Placeholder for a variable not yet bound at the control-flow site
+    (reference: dygraph_to_static UndefinedVar).  Write-only in branches;
+    reading it raises naturally."""
+
+    def __repr__(self):
+        return "<dy2static UNDEFINED>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _local_default(lcls, name):
+    """Runtime lookup used by generated code: current local value or the
+    UNDEFINED placeholder when the name is not bound yet."""
+    return lcls.get(name, UNDEFINED)
+
+
+def _as_array(x):
+    from ..core.tensor import Tensor
+    return x._array if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    x = _as_array(x)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(x) -> bool:
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor) or isinstance(x, jax.Array) or _is_traced(x)
+
+
+def convert_bool(pred):
+    """Predicate for the rewritten condition: jnp bool scalar when traced."""
+    a = _as_array(pred)
+    if hasattr(a, "dtype"):
+        return jnp.asarray(a).astype(bool).reshape(())
+    return bool(pred)
+
+
+def _rewrap(arrs, like):
+    """Re-wrap branch operands/results as Tensors where the originals were
+    (branch bodies were written against the Tensor API)."""
+    from ..core.tensor import Tensor
+    out = []
+    for a, l in zip(arrs, like):
+        if isinstance(l, Tensor) and hasattr(a, "dtype"):
+            out.append(Tensor(a))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def _unwrap_all(vals):
+    from ..core.tensor import Tensor
+    return tuple(v._array if isinstance(v, Tensor) else v for v in vals)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args: tuple):
+    """reference parity: convert_operators.py convert_ifelse — tensor pred
+    lowers to lax.cond; Python pred runs one branch eagerly."""
+    from ..core.tensor import Tensor
+
+    if _is_traced(pred) or any(map(_is_traced, _unwrap_all(args))):
+        a = convert_bool(pred)
+        # UNDEFINED placeholders (vars first bound inside the branches) are
+        # write-only: keep them out of the cond carry, splice back for the
+        # branch call
+        live = [i for i, v in enumerate(args) if v is not UNDEFINED]
+        live_args = tuple(args[i] for i in live)
+
+        def wrap(fn):
+            def inner(operands):
+                full = list(args)
+                for i, v in zip(live, _rewrap(operands, live_args)):
+                    full[i] = v
+                out = fn(*full)
+                return jax.tree_util.tree_map(
+                    _as_array, out, is_leaf=lambda l: isinstance(l, Tensor))
+            return inner
+
+        out = jax.lax.cond(a, wrap(true_fn), wrap(false_fn),
+                           _unwrap_all(live_args))
+        return jax.tree_util.tree_map(
+            lambda l: Tensor(l) if hasattr(l, "dtype") else l, out)
+    if _is_tensorish(pred):
+        # concrete tensor outside tracing: plain Python dispatch
+        return true_fn(*args) if bool(_as_array(pred)) else false_fn(*args)
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, args: tuple):
+    """reference parity: convert_operators.py convert_while_loop."""
+    from ..core.tensor import Tensor
+
+    first = cond_fn(*args)
+    if _is_traced(first) or any(map(_is_traced, _unwrap_all(args))):
+        if any(v is UNDEFINED for v in args):
+            raise Dy2StaticUnsupportedError(
+                "a variable assigned inside a converted while loop must be "
+                "bound before the loop (lax.while_loop carries need a "
+                "defined initial value)")
+        def cond(operands):
+            return convert_bool(cond_fn(*_rewrap(operands, args)))
+
+        def body(operands):
+            out = body_fn(*_rewrap(operands, args))
+            out = _unwrap_all(out)
+            # keep carry dtypes stable for while_loop typing
+            return tuple(
+                jnp.asarray(o).astype(jnp.asarray(a).dtype)
+                if hasattr(a, "dtype") and hasattr(o, "dtype") else o
+                for o, a in zip(out, operands))
+
+        out = jax.lax.while_loop(cond, body, _unwrap_all(args))
+        return tuple(Tensor(o) if hasattr(o, "dtype") else o for o in out)
+    vals = args
+    while bool(_as_array(cond_fn(*vals))):
+        vals = body_fn(*vals)
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# AST transformer (reference: ifelse_transformer.py / loop_transformer.py)
+# ---------------------------------------------------------------------------
+
+_RT = "__dy2static_rt"
+
+
+def _store_names(stmts) -> set:
+    names = set()
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                names.add(node.target.id)
+    return names
+
+
+def _load_names(node) -> set:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _has_stmt(stmts, kinds) -> bool:
+    return any(isinstance(node, kinds)
+               for st in stmts for node in ast.walk(st))
+
+
+def _ends_in_return(stmts) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+def _make_branch_fn(name, argnames, body, extra_return):
+    """def <name>(a, b, ...): <body>; return (a, b, ...)"""
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    stmts = list(body)
+    if extra_return:
+        stmts.append(ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=a, ctx=ast.Load()) for a in argnames],
+            ctx=ast.Load())))
+    return ast.FunctionDef(name=name, args=args, body=stmts,
+                           decorator_list=[], returns=None, type_params=[])
+
+
+def _call_rt(fn_name, *args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_RT, ctx=ast.Load()),
+                           attr=fn_name, ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _args_tuple(names):
+    """(rt._local_default(locals(), 'a'), ...) — tolerates names not yet
+    bound at the control-flow site (UNDEFINED placeholder)."""
+    return ast.Tuple(
+        elts=[_call_rt("_local_default",
+                       ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                                args=[], keywords=[]),
+                       ast.Constant(a)) for a in names],
+        ctx=ast.Load())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _next(self, stem):
+        self._uid += 1
+        return "__jst_%s_%d" % (stem, self._uid)
+
+    # -- if/elif/else ------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        if _has_stmt(body + orelse, (ast.Break, ast.Continue)):
+            raise Dy2StaticUnsupportedError(
+                "break/continue inside a converted if branch")
+        body_returns = _ends_in_return(body)
+        orelse_returns = _ends_in_return(orelse)
+        if body_returns != orelse_returns or (
+                _has_stmt(body[:-1] if body_returns else body, ast.Return)
+                or _has_stmt(orelse[:-1] if orelse_returns else orelse,
+                             ast.Return)):
+            raise Dy2StaticUnsupportedError(
+                "if branches must either both end in `return` or contain "
+                "no returns at all (reference return_transformer scope); "
+                "restructure or use static.nn.cond directly")
+
+        tname, fname = self._next("true"), self._next("false")
+        if body_returns:
+            # both branches return: wrap bodies, return the dispatch
+            tfn = _make_branch_fn(tname, [], body, extra_return=False)
+            ffn = _make_branch_fn(
+                fname, [], orelse or [ast.Return(value=ast.Constant(None))],
+                extra_return=False)
+            call = _call_rt("convert_ifelse", node.test,
+                            ast.Name(id=tname, ctx=ast.Load()),
+                            ast.Name(id=fname, ctx=ast.Load()),
+                            ast.Tuple(elts=[], ctx=ast.Load()))
+            return [tfn, ffn, ast.Return(value=call)]
+
+        assigned = sorted(_store_names(body) | _store_names(orelse))
+        if not assigned:
+            raise Dy2StaticUnsupportedError(
+                "if branch assigns nothing and does not return — side "
+                "effects inside converted branches are not supported")
+        tfn = _make_branch_fn(tname, assigned, body, extra_return=True)
+        ffn = _make_branch_fn(fname, assigned,
+                              orelse or [ast.Pass()], extra_return=True)
+        call = _call_rt("convert_ifelse", node.test,
+                        ast.Name(id=tname, ctx=ast.Load()),
+                        ast.Name(id=fname, ctx=ast.Load()),
+                        _args_tuple(assigned))
+        target = ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Store())
+                                 for a in assigned], ctx=ast.Store())
+        assign = ast.Assign(targets=[target], value=call)
+        return [tfn, ffn, assign]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            raise Dy2StaticUnsupportedError("while/else is not supported")
+        if _has_stmt(node.body, (ast.Break, ast.Continue, ast.Return)):
+            raise Dy2StaticUnsupportedError(
+                "break/continue/return inside a converted while loop; "
+                "restructure or use static.nn.while_loop directly")
+        carried = sorted(_store_names(node.body)
+                         | (_store_names(node.body) & _load_names(node.test)))
+        if not carried:
+            raise Dy2StaticUnsupportedError(
+                "while body assigns no variables — infinite or effect-only "
+                "loops are not convertible")
+        cname, bname = self._next("cond"), self._next("body")
+        cfn = _make_branch_fn(cname, carried,
+                              [ast.Return(value=node.test)],
+                              extra_return=False)
+        bfn = _make_branch_fn(bname, carried, node.body, extra_return=True)
+        call = _call_rt("convert_while",
+                        ast.Name(id=cname, ctx=ast.Load()),
+                        ast.Name(id=bname, ctx=ast.Load()),
+                        _args_tuple(carried))
+        target = ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Store())
+                                 for a in carried], ctx=ast.Store())
+        return [cfn, bfn, ast.Assign(targets=[target], value=call)]
+
+
+class _NeedsTransform(ast.NodeVisitor):
+    """Cheap pre-scan: only rewrite sources that contain if/while at all."""
+    found = False
+
+    def visit_If(self, node):
+        self.found = True
+
+    def visit_While(self, node):
+        self.found = True
+
+
+def transform_function(fn: Callable):
+    """Rewrite ``fn``'s if/while statements through the runtime dispatchers.
+
+    Returns the transformed function, or ``fn`` unchanged when there is
+    nothing to rewrite.  Raises Dy2StaticUnsupportedError for control-flow
+    shapes outside the supported subset (callers catch it and fall back to
+    trace-only to_static).
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    scan = _NeedsTransform()
+    scan.visit(tree)
+    if not scan.found:
+        return fn
+
+    func_def = tree.body[0]
+    if not isinstance(func_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    func_def.decorator_list = []  # do not re-apply @to_static etc.
+    new_name = func_def.name + "__dy2static"
+    func_def.name = new_name
+    tree = ast.fix_missing_locations(
+        _ControlFlowTransformer().visit(tree))
+
+    # rebuild the defining namespace: module globals + closure cells
+    glb = dict(getattr(fn, "__globals__", {}))
+    try:
+        closure = inspect.getclosurevars(fn)
+        glb.update(closure.nonlocals)
+    except (TypeError, ValueError):
+        pass
+    import paddle_tpu.jit.dy2static as rt_mod
+    glb[_RT] = rt_mod
+    code = compile(tree, filename="<dy2static:%s>" % getattr(
+        fn, "__qualname__", "fn"), mode="exec")
+    ns = {}
+    exec(code, glb, ns)
+    out = ns[new_name]
+    out = functools.wraps(fn)(out)
+    out.__dy2static_transformed__ = True
+    return out

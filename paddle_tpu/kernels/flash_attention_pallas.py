@@ -60,6 +60,13 @@ def _block_env(name, default):
 DEFAULT_BLOCK_Q = _block_env("PADDLE_TPU_FLASH_BLOCK_Q", 512)
 DEFAULT_BLOCK_K = _block_env("PADDLE_TPU_FLASH_BLOCK_K", 512)
 _NEG_INF = -1e30
+# The streaming softmax runs in BASE 2: folding log2(e) into the logits
+# scale turns every exp into the VPU's native exp2 (jnp.exp lowers to
+# exp2 + a multiply per element, and the softmax exp over b*h*s^2 logits
+# is the kernel's dominant VPU cost).  lse is therefore stored in base-2
+# units; the backward consumes it with exp2 as well, and d/d(qk) keeps the
+# plain base-e `scale` factor (dS = scale * P * (dP - delta) regardless).
+_LOG2E = 1.4426950408889634
 
 _SEQ2 = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"))
@@ -191,7 +198,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
                 # operands first quarters matmul throughput
                 logits = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * jnp.float32(scale)
+                    preferred_element_type=jnp.float32) * \
+                    jnp.float32(scale * _LOG2E)
                 if masked:
                     col_ids = start[None, None] + \
                         jax.lax.broadcasted_iota(
@@ -199,8 +207,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
                     logits = jnp.where(col_ids <= row_ids, logits,
                                        jnp.float32(_NEG_INF))
                 new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
-                correction = jnp.exp(m - new_m)
-                p = jnp.exp(logits - new_m[:, None])
+                correction = jnp.exp2(m - new_m)
+                p = jnp.exp2(logits - new_m[:, None])
                 new_l = l * correction + jnp.sum(p, axis=-1)
                 new_acc = acc * correction[:, None] + jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -227,8 +235,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
                                           make_body(False), init)
         l_safe = jnp.maximum(l, jnp.float32(1e-30))
         o_ref[0, :, sl] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        # lse in base-2 units: m is already log2-scaled
         lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
-            (m + jnp.log(l_safe))[None, :]
+            (m + jnp.log(l_safe) * jnp.float32(_LOG2E))[None, :]
 
 
 def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
@@ -263,13 +272,14 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc
             # operands first quarters matmul throughput
             logits = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * jnp.float32(scale)
+                preferred_element_type=jnp.float32) * \
+                jnp.float32(scale * _LOG2E)
             if masked:
                 logits = jnp.where(mask, logits, jnp.float32(_NEG_INF))
             m = m_sc[hh]
             new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
-            correction = jnp.exp(m - new_m)
-            p = jnp.exp(logits - new_m[:, None])
+            correction = jnp.exp2(m - new_m)
+            p = jnp.exp2(logits - new_m[:, None])
             l_sc[hh] = l_sc[hh] * correction + jnp.sum(p, axis=-1)
             acc_sc[:, sl] = acc_sc[:, sl] * correction[:, None] + \
                 jax.lax.dot_general(
@@ -306,8 +316,9 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc
             l_safe = jnp.maximum(l_sc[hh], jnp.float32(1e-30))
             o_ref[0, :, sl] = (acc_sc[:, sl] /
                                l_safe[:, None]).astype(o_ref.dtype)
+            # lse in base-2 units (see _LOG2E)
             lse_ref[0, 0, hh, pl.ds(qi, 1), :] = \
-                (m_sc[hh] + jnp.log(l_safe))[None, :]
+                (m_sc[hh] + jnp.log(l_safe) * jnp.float32(_LOG2E))[None, :]
 
 
 
@@ -422,12 +433,12 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k = k_ref[0, :, sl]                       # (BK, D)
             v = v_ref[0, :, sl]
             do = do_ref[0, :, sl]
-            lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # (BQ,) f32
+            lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # (BQ,) f32, base-2
             delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]  # (BQ,) f32
-            logits = jnp.float32(scale) * jax.lax.dot_general(
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)          # (BQ, BK)
-            p = jnp.exp(logits - lse[:, None])
+            p = jnp.exp2(logits - lse[:, None])
             if causal:
                 p = jnp.where(mask, p, jnp.float32(0.0))
             pc = p.astype(do.dtype)

@@ -1,0 +1,159 @@
+"""dy2static AST control-flow conversion (VERDICT r2 Missing #4).
+
+Done-criterion: tensor-dependent Python ``if``/``while`` pass under
+``to_static`` (and ``jit.save``) instead of raising a jax tracer error —
+the reference's ast_transformer.py + convert_operators.py behavior
+(program_translator.py:236).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import (Dy2StaticUnsupportedError,
+                                      transform_function)
+
+
+def test_tensor_if_assignment_branch():
+    @to_static
+    def f(x):
+        if ops.sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y + 1.0
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x).numpy()), 3.0)
+    xneg = paddle.to_tensor(-np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xneg).numpy()), 1.0 - 1.0 - 1.0)
+
+
+def test_tensor_if_both_return():
+    @to_static
+    def f(x):
+        if ops.mean(x) > 1.0:
+            return x * 10.0
+        else:
+            return x * 0.5
+
+    big = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+    small = paddle.to_tensor(np.full((3,), 0.5, np.float32))
+    np.testing.assert_allclose(np.asarray(f(big).numpy()), 20.0)
+    np.testing.assert_allclose(np.asarray(f(small).numpy()), 0.25)
+
+
+def test_tensor_while_loop():
+    @to_static
+    def f(x):
+        # double until the sum crosses 100 — iteration count depends on
+        # the DATA, impossible under plain tracing
+        s = ops.sum(x)
+        while s < 100.0:
+            x = x * 2.0
+            s = ops.sum(x)
+        return x
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))   # sum 4 -> 128
+    np.testing.assert_allclose(np.asarray(f(x).numpy()), 32.0)
+    y = paddle.to_tensor(np.full((4,), 30.0, np.float32))  # sum 120 stays
+    np.testing.assert_allclose(np.asarray(f(y).numpy()), 30.0)
+
+
+def test_python_if_still_static():
+    # data-INdependent branch: condition is a plain bool — must behave as
+    # normal Python (each call pattern traces its own branch)
+    @to_static
+    def f(x, flag):
+        if flag:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x, True).numpy()), 1.0)
+    np.testing.assert_allclose(np.asarray(f(x, False).numpy()), -1.0)
+
+
+def test_layer_forward_with_tensor_branch():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if ops.mean(h) > 0:
+                out = nn.functional.relu(h)
+            else:
+                out = h * 0.1
+            return out
+
+    paddle.seed(0)
+    m = Gate()
+    sf = to_static(m)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    out = sf(x)
+    assert out.shape == [2, 4]
+    # eager behavior matches (runtime dispatch takes the Python path)
+    eager = m(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(eager.numpy()), rtol=1e-6)
+
+
+def test_jit_save_with_tensor_branch(tmp_path):
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if ops.sum(h) > 0:
+                return h * 2.0
+            else:
+                return h * -1.0
+
+    paddle.seed(1)
+    m = Gate()
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+    path = str(tmp_path / "gate_model")
+    jit.save(to_static(m), path,
+             input_spec=[InputSpec([2, 4], "float32", "x")])
+    loaded = jit.load(path)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(2, 4)
+                         .astype(np.float32))
+    got = loaded(x)
+    want = m(x)
+    g = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(np.asarray(g.numpy()),
+                               np.asarray(want.numpy()), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_unsupported_shapes_raise_loudly():
+    def has_break(x):
+        while ops.sum(x) < 10:
+            x = x * 2
+            if ops.sum(x) > 5:
+                break
+        return x
+
+    with pytest.raises(Dy2StaticUnsupportedError):
+        transform_function(has_break)
+
+
+def test_mixed_return_assign_raises():
+    def mixed(x):
+        if ops.sum(x) > 0:
+            return x
+        else:
+            y = x + 1
+        return y
+
+    with pytest.raises(Dy2StaticUnsupportedError):
+        transform_function(mixed)

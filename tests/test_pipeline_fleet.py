@@ -1,0 +1,178 @@
+"""Fleet pipeline API driving the COMPILED 1F1B (VERDICT r2 Missing #2).
+
+Done-criterion: a tiny GPT-shaped model with TIED embeddings
+(SharedLayerDesc), built through the fleet desc API, 1F1B-trains on the
+8-CPU mesh via ``fleet.distributed_model(...).train_batch`` with losses
+matching a sequential eager run of the same layers (reference semantics:
+fleet/meta_parallel/pipeline_parallel.py train_batch +
+parallel_layers/pp_layers.py:49 SharedLayerDesc weight tying + the
+shared-embedding grad allreduce in the 1F1B cooldown).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import (LayerDesc, PipelineLayer,
+                                             PipelineParallel,
+                                             SharedLayerDesc)
+
+V, H, S = 64, 16, 8
+
+
+class EmbedPipe(nn.Layer):
+    """Token + position embedding (first pipeline stage)."""
+
+    def __init__(self):
+        super().__init__()
+        self.word = nn.Embedding(V, H)
+        self.pos = nn.Embedding(S, H)
+
+    @property
+    def weight(self):
+        return self.word.weight
+
+    @weight.setter
+    def weight(self, value):
+        self.word.weight = value
+
+    def forward(self, ids):
+        p = ops.arange(0, ids.shape[1], dtype="int32")
+        return self.word(ids) + self.pos(ops.unsqueeze(p, 0))
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return x + ops.tanh(self.fc(x))
+
+
+def tied_logits(layer, x):
+    # the tied LM head: logits = x @ wte^T
+    return ops.matmul(x, layer.word.weight, transpose_y=True)
+
+
+class Criterion(nn.Layer):
+    def forward(self, logits, labels):
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+
+def _descs():
+    return [
+        SharedLayerDesc("embed", EmbedPipe, shared_weight_attr="weight"),
+        *[LayerDesc(Block) for _ in range(8)],
+        SharedLayerDesc("embed", EmbedPipe, forward_func=tied_logits,
+                        shared_weight_attr="weight"),
+    ]
+
+
+def _data(num_batches=3):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(num_batches):
+        ids = rng.randint(0, V, (8, S)).astype(np.int32)
+        out.append((paddle.to_tensor(ids), paddle.to_tensor(ids)))
+    return out
+
+
+def test_shared_desc_ties_weights_eager():
+    paddle.seed(11)
+    pl = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+    layers = list(pl.run_function)
+    head = layers[-1]
+    # the head wrapper aliases the embed stage's word embedding
+    assert head.shared.word.weight is layers[0].word.weight
+    # id-dedup: the tied weight appears once in parameters()
+    ids = [id(p) for p in pl.parameters()]
+    assert len(ids) == len(set(ids))
+
+
+def test_fleet_pp_compiled_1f1b_tied_embeddings():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+
+    # ---- sequential eager reference (same seed, same microbatching) ------
+    paddle.seed(11)
+    ref = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+    ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=ref.parameters())
+    acc = 4
+
+    def ref_step(x, y):
+        total = None
+        mb = x.shape[0] // acc
+        for i in range(acc):
+            h = x[i * mb:(i + 1) * mb]
+            for layer in ref.run_function:
+                h = layer(h)
+            loss = ref.loss_fn(h, y[i * mb:(i + 1) * mb])
+            (loss / acc).backward()
+            total = loss.detach() if total is None else total + loss.detach()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        return float((total / acc).numpy())
+
+    # ---- compiled 1F1B through the fleet API -----------------------------
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": acc}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(11)
+    pl = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+    model = fleet.distributed_model(pl)
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    try:
+        for step_i, (x, y) in enumerate(_data(3)):
+            ref_loss = ref_step(x, y)
+            loss = model.train_batch((x, y), opt)
+            np.testing.assert_allclose(
+                float(loss.numpy()), ref_loss, rtol=2e-4, atol=1e-5,
+                err_msg="step %d" % step_i)
+        # the compiled path was actually taken
+        assert model._compiled is not None
+        # trained weights written back match the reference (incl. the tied
+        # embedding, which received both lookup and head grads)
+        model.sync_to_layers()
+        ref_params = dict(ref.named_parameters())
+        got_params = dict(pl.named_parameters())
+        assert set(ref_params) == set(got_params)
+        for k in ref_params:
+            np.testing.assert_allclose(
+                np.asarray(got_params[k].numpy()),
+                np.asarray(ref_params[k].numpy()),
+                atol=5e-4, rtol=1e-3, err_msg=k)
+    finally:
+        mesh_mod.init_mesh({"dp": 1})  # reset global mesh for other tests
+
+
+def test_compiled_pipeline_rejects_ragged_blocks():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh_mod.init_mesh({"pp": 4})
+    try:
+        paddle.seed(0)
+        descs = [SharedLayerDesc("embed", EmbedPipe),
+                 *[LayerDesc(Block) for _ in range(6)],  # 6 % 4 != 0
+                 SharedLayerDesc("embed", EmbedPipe, forward_func=tied_logits)]
+        pl = PipelineLayer(descs, num_stages=4, loss_fn=Criterion())
+        model = PipelineParallel(pl)
+        model.accumulate_steps = 4
+        x = paddle.to_tensor(np.zeros((8, S), np.int32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        with pytest.raises(ValueError, match="not divisible"):
+            model.train_batch((x, x), opt)
+    finally:
+        mesh_mod.init_mesh({"dp": 1})

@@ -1,0 +1,84 @@
+"""Trace 4 bench steps with jax.profiler and print per-op-category times.
+
+Usage: python tools/profile_bench.py [outdir]
+Parses the XPlane trace-event JSON (chrome trace) for TPU op durations.
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jaxtrace"
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt2_medium()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    batch, seq = 8, 1024
+    model = GPTForCausalLM(cfg)
+    paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, lambda lg, lb: crit(lg, lb), opt)
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = jnp.asarray(ids)
+    for _ in range(3):
+        loss = step(x, x)
+    loss._array.block_until_ready()
+    with jax.profiler.trace(outdir):
+        for _ in range(4):
+            loss = step(x, x)
+        loss._array.block_until_ready()
+    time.sleep(1)
+    # parse newest trace.json.gz
+    paths = sorted(glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True), key=os.path.getmtime)
+    if not paths:
+        print("NO TRACE FOUND")
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    # find TPU op track pids (XLA Ops on device)
+    pid_names = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    buckets = defaultdict(float)
+    total = 0.0
+    for e in events:
+        pname = pid_names.get(e["pid"], "")
+        if "TPU" not in pname and "/device" not in pname:
+            continue
+        tname = e.get("name", "")
+        dur = e.get("dur", 0) / 1e3  # ms
+        # only leaf op events on the XLA Ops line
+        args = e.get("args", {})
+        if "run_id" in args or tname.startswith("jit_"):
+            continue
+        total += dur
+        key = tname.split(".")[0]
+        buckets[key] += dur
+    print("total device op-ms over 4 steps: %.1f (%.1f ms/step)" % (total, total / 4))
+    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1])[:30]:
+        print("%10.2f ms/step  %s" % (v / 4, k))
+
+
+if __name__ == "__main__":
+    main()
