@@ -159,7 +159,14 @@ class Launcher:
                         f"restart {self._restarts[lr]}/{self.max_restarts}\n")
                     p, log = self._start_one(lr, cmd)
                     self._procs[lr] = p
-                    self._logs.append(log)
+                    try:
+                        # close the dead worker's log handle before
+                        # replacing it — appending leaked one fd per
+                        # restart across long elastic runs
+                        self._logs[lr].close()
+                    except Exception:
+                        pass
+                    self._logs[lr] = log
                 else:
                     sys.stderr.write(
                         f"[launch] worker {lr} exited rc={rc}; aborting job\n")

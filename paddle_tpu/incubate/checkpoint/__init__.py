@@ -28,6 +28,7 @@ import queue
 import re
 import shutil
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -124,7 +125,14 @@ def _from_host(obj, template=None):
 
 class CheckpointManager:
     """Directory of ``ckpt-<step>`` checkpoints with async sharded save,
-    atomic publish, retention, and newest-complete restore."""
+    atomic publish, retention, and newest-complete restore.
+
+    Multi-host REQUIREMENT: ``directory`` must be ONE shared filesystem
+    (NFS/GCS-fuse/...) visible to every host — each host writes its
+    ``host-<i>.ckpt`` shard into the same ``ckpt-<step>`` directory and
+    host 0 publishes the DONE marker only after verifying every expected
+    shard file is present (per-host local disks would publish a checkpoint
+    whose peer shards live elsewhere and only fail at restore)."""
 
     _STEP_RE = re.compile(r"^ckpt-(\d+)$")
 
@@ -196,6 +204,30 @@ class CheckpointManager:
         # publishes (renames + DONE)
         self._barrier(f"ckpt-written-{step}")
         if self._host == 0:
+            # verify every host's shard landed in the SHARED directory
+            # before publishing — catches a per-host-local-disk
+            # misconfiguration at save time instead of at restore.
+            # open() (not os.path.exists) + a short retry: NFS negative
+            # dentry caching can report a peer's just-written file absent
+            # within the attribute-cache window
+            def shard_visible(path, tries=10, delay=0.5):
+                for _ in range(tries):
+                    try:
+                        with open(path, "rb"):
+                            return True
+                    except OSError:
+                        time.sleep(delay)
+                return False
+
+            missing = [i for i in range(self._nhosts)
+                       if not shard_visible(
+                           os.path.join(tmp, f"host-{i}.ckpt"))]
+            if missing:
+                raise RuntimeError(
+                    "checkpoint %s: shard files for hosts %r are absent "
+                    "after the write barrier — the checkpoint directory "
+                    "must be one shared filesystem visible to all hosts"
+                    % (final, missing))
             os.replace(tmp, final)
             with open(os.path.join(final, "DONE"), "w") as f:
                 f.write(str(self._nhosts))

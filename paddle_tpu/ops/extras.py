@@ -17,7 +17,7 @@ __all__ = [
     "set_printoptions", "check_shape", "create_array", "array_write",
     "array_read", "array_length",
     # module-level in-place forms (delegate to the Tensor methods)
-    "add_", "subtract_", "clip_", "ceil_", "exp_", "floor_", "reciprocal_",
+    "add_", "subtract_", "divide_", "clip_", "ceil_", "exp_", "floor_", "reciprocal_",
     "round_", "rsqrt_", "sqrt_", "scale_", "tanh_", "erfinv_", "lerp_",
     "reshape_", "flatten_", "squeeze_", "unsqueeze_", "scatter_",
     "put_along_axis_", "uniform_", "exponential_",
@@ -153,10 +153,19 @@ def create_array(dtype="float32", initialized_list=None):
     return list(initialized_list or [])
 
 
+def _array_index(i):
+    """Accept python ints and scalar/shape-[1] int tensors (the reference's
+    array ops take a shape-[1] int64 index variable)."""
+    if isinstance(i, Tensor):
+        i = i._array
+    return int(np.asarray(i).reshape(-1)[0]) if hasattr(i, "shape") \
+        and getattr(i, "ndim", 0) > 0 else int(i)
+
+
 def array_write(x, i, array=None):
     if array is None:
         array = []
-    i = int(i)
+    i = _array_index(i)
     while len(array) <= i:
         array.append(None)
     array[i] = x
@@ -164,7 +173,7 @@ def array_write(x, i, array=None):
 
 
 def array_read(array, i):
-    return array[int(i)]
+    return array[_array_index(i)]
 
 
 def array_length(array):
@@ -183,6 +192,7 @@ def _mk_inplace(method_name):
 
 add_ = _mk_inplace("add_")
 subtract_ = _mk_inplace("subtract_")
+divide_ = _mk_inplace("divide_")
 clip_ = _mk_inplace("clip_")
 ceil_ = _mk_inplace("ceil_")
 exp_ = _mk_inplace("exp_")

@@ -108,7 +108,22 @@ triangular_solve = wrap_op(
 cholesky_solve = wrap_op(
     lambda x, y, upper=False: jax.scipy.linalg.cho_solve((y, not upper), x),
     name="cholesky_solve")
-lu = wrap_op(lambda x: tuple(jax.scipy.linalg.lu(x, permute_l=False)), name="lu")
+@wrap_op
+def lu(x, pivot=True, get_infos=False):
+    """reference: paddle.linalg.lu — returns the PACKED factorization
+    (LU combined in one matrix, 1-based sequential-swap pivots, and info
+    when get_infos=True), consumable by :func:`lu_unpack` (the round-trip
+    P@L@U == x is test-asserted).  Previous revisions returned scipy-style
+    (P, L, U), which broke the lu -> lu_unpack contract."""
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False): XLA's LU is always partial-pivoted; "
+            "reconstruct with the returned pivots (lu_unpack)")
+    packed, pivots, _perm = jax.lax.linalg.lu(x)
+    pivots = pivots.astype(jnp.int32) + 1      # paddle pivots are 1-based
+    if get_infos:
+        return packed, pivots, jnp.zeros(x.shape[:-2], jnp.int32)
+    return packed, pivots
 corrcoef = wrap_op(lambda x, rowvar=True: jnp.corrcoef(x, rowvar=rowvar), name="corrcoef")
 cov = wrap_op(lambda x, rowvar=True, ddof=True, fweights=None, aweights=None:
               jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,

@@ -51,7 +51,6 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
     return (x + jnp.asarray(bias, x.dtype)) * scale
 
 
-divide_ = divide
 
 # -- unary -------------------------------------------------------------------
 

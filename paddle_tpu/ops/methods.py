@@ -103,6 +103,7 @@ def install():
     T.add_ = lambda self, y: _inplace(self, math.add, y)
     T.subtract_ = lambda self, y: _inplace(self, math.subtract, y)
     T.multiply_ = lambda self, y: _inplace(self, math.multiply, y)
+    T.divide_ = lambda self, y: _inplace(self, math.divide, y)
     T.scale_ = lambda self, s, bias=0.0: _inplace(self, math.scale, s, bias)
     T.zero_ = lambda self: _inplace(self, creation.zeros_like)
     T.fill_ = lambda self, v: _inplace(self, creation.full_like, v)

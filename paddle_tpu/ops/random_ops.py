@@ -65,8 +65,11 @@ def randint(low=0, high=None, shape=(1,), dtype="int64"):
 
 
 def randint_like(x, low=0, high=None, dtype=None):
+    # reference allows FLOAT output dtypes (randint_like returns x's dtype
+    # by default): sample integers, then cast
     dtype = dtype or x.dtype
-    return randint(low, high, x.shape, dtype)
+    out = randint(low, high, x.shape, "int64")
+    return out.astype(dtype)
 
 
 def randperm(n, dtype="int64"):

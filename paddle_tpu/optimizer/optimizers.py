@@ -72,6 +72,12 @@ class Adam(Optimizer):
             slots["moment2_max"] = jnp.zeros(p.shape, jnp.float32)
         return slots
 
+    # NOTE: a fused Pallas AdamW kernel was tried for the mid-size-param
+    # update inefficiency (XLA's per-param fusions run ~250 GB/s vs ~700 on
+    # big arrays, PERF.md) and measured SLOWER end-to-end on the 345M bench
+    # (45.4k vs 52.2k tokens/s — per-pallas_call overhead x ~150 params
+    # dominates); the XLA fusion path below stays.
+
     def update_one(self, g, p, slots, lr, step):
         g = _wd_grad(self, g, p)
         g32 = g.astype(jnp.float32)

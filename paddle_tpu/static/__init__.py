@@ -302,6 +302,17 @@ class nn:
         # index of first true pred (or len(preds) for default)
         stack = jnp.stack([jnp.asarray(p).astype(bool).reshape(())
                            for p in preds])
+        if default is None:
+            # reference contract (layers/control_flow.py case): no match and
+            # no default is an error.  Enforceable only for concrete preds;
+            # traced preds fall through to the LAST branch (documented).
+            try:
+                if not bool(stack.any()):
+                    raise ValueError(
+                        "static.nn.case: no predicate matched and no "
+                        "default branch was given")
+            except jax.errors.TracerBoolConversionError:
+                pass
         idx = jnp.where(stack.any(), jnp.argmax(stack), len(preds))
         idx = jnp.minimum(idx, len(fns) - 1)
         out = lax.switch(idx, [lambda _, f=f: _unwrap_all(f()) for f in fns],
@@ -325,6 +336,17 @@ class nn:
         bi = branch_index._array if isinstance(branch_index, Tensor) \
             else branch_index
         bi = jnp.asarray(bi).reshape(()).astype(jnp.int32)
+        if default is None:
+            # reference contract: an out-of-range index without a default
+            # is an error (enforceable for concrete indices only; traced
+            # indices fall through to the last branch)
+            try:
+                if int(bi) not in keys:
+                    raise ValueError(
+                        "static.nn.switch_case: branch_index %d not in %r "
+                        "and no default branch was given" % (int(bi), keys))
+            except jax.errors.TracerIntegerConversionError:
+                pass
         # map branch_index -> position in keys (default otherwise)
         pos = jnp.full((), len(fns) - 1, jnp.int32)
         for i, k in enumerate(keys):

@@ -274,28 +274,47 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         rh = jnp.maximum(rh, 1.0)
     bin_h = rh / ph
     bin_w = rw / pw
-    sr = sampling_ratio if sampling_ratio > 0 else 2
-    # sample grid: (ph, sr) x (pw, sr) points per roi
+    if sampling_ratio > 0:
+        srm = int(sampling_ratio)
+        ry = jnp.full((k,), float(srm), jnp.float32)
+        rx = jnp.full((k,), float(srm), jnp.float32)
+    else:
+        # reference adaptive grid: ceil(bin_h) x ceil(bin_w) samples per
+        # bin, per RoI (phi roi_align kernel).  XLA needs static shapes, so
+        # sample a static SRM x SRM grid and MASK to the first
+        # ceil(bin)<=SRM rows/cols per RoI; RoIs whose adaptive count
+        # exceeds SRM are clamped (documented deviation — beyond 4x4
+        # samples per bin the bilinear average has converged for typical
+        # feature maps).
+        srm = 4
+        ry = jnp.clip(jnp.ceil(bin_h), 1.0, srm)
+        rx = jnp.clip(jnp.ceil(bin_w), 1.0, srm)
     iy = jnp.arange(ph, dtype=jnp.float32)
     ix = jnp.arange(pw, dtype=jnp.float32)
-    sy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
-    sx = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
-    # y coords: (K, ph, sr)
+    samp = jnp.arange(srm, dtype=jnp.float32)
+    sy = (samp[None, :] + 0.5) / ry[:, None]                # (K, srm)
+    sx = (samp[None, :] + 0.5) / rx[:, None]
+    my = (samp[None, :] < ry[:, None]).astype(jnp.float32)  # (K, srm)
+    mx = (samp[None, :] < rx[:, None]).astype(jnp.float32)
+    # y coords: (K, ph, srm)
     yy = by0[:, None, None] + (iy[None, :, None] +
-                               sy[None, None, :]) * bin_h[:, None, None]
+                               sy[:, None, :]) * bin_h[:, None, None]
     xx = bx0[:, None, None] + (ix[None, :, None] +
-                               sx[None, None, :]) * bin_w[:, None, None]
+                               sx[:, None, :]) * bin_w[:, None, None]
+    cnt = ry * rx                                           # (K,)
 
-    def per_roi(bi, ys, xs):
+    def per_roi(bi, ys, xs, myk, mxk, cn):
         fm = x[bi]                                          # (C, H, W)
-        grid_y = ys[:, :, None, None]                       # (ph, sr, 1, 1)
-        grid_x = xs[None, None, :, :]                       # (1, 1, pw, sr)
+        grid_y = ys[:, :, None, None]                       # (ph, srm, 1, 1)
+        grid_x = xs[None, None, :, :]                       # (1, 1, pw, srm)
         vals = _bilinear(fm, jnp.broadcast_to(
-            grid_y, (ph, sr, pw, sr)), jnp.broadcast_to(
-            grid_x, (ph, sr, pw, sr)))                      # (C,ph,sr,pw,sr)
-        return jnp.mean(vals, axis=(2, 4))                  # (C, ph, pw)
+            grid_y, (ph, srm, pw, srm)), jnp.broadcast_to(
+            grid_x, (ph, srm, pw, srm)))                    # (C,ph,srm,pw,srm)
+        mask = myk[None, None, :, None, None] * mxk[None, None, None,
+                                                    None, :]
+        return (vals * mask).sum(axis=(2, 4)) / cn          # (C, ph, pw)
 
-    return jax.vmap(per_roi)(batch_idx, yy, xx)
+    return jax.vmap(per_roi)(batch_idx, yy, xx, my, mx, cnt)
 
 
 @wrap_op
