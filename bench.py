@@ -31,11 +31,14 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     paddle.seed(0)
 
+    import os
     if on_tpu:
         cfg = GPTConfig.gpt2_medium()
         # 48 timed steps: the 12-step window undersold steady state by ~3%
         # (dispatch ramp through the remote tunnel; see PERF.md)
         batch, seq, steps, warmup = 8, 1024, 48, 5
+        batch = int(os.getenv("PADDLE_TPU_BENCH_BATCH", batch))
+        seq = int(os.getenv("PADDLE_TPU_BENCH_SEQ", seq))
     else:  # CPU smoke config so bench.py always runs
         cfg = GPTConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 4, 1

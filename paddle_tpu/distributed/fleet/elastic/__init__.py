@@ -42,7 +42,8 @@ class ElasticManager:
     def __init__(self, store=None, job_id: Optional[str] = None,
                  np_: Optional[int] = None, node_rank: Optional[int] = None,
                  heartbeat_interval: float = 0.5,
-                 node_timeout: float = 3.0):
+                 node_timeout: float = 3.0,
+                 max_np: Optional[int] = None):
         if store is None:
             from ...store import TCPStore
             master = os.getenv("PADDLE_ELASTIC_SERVER",
@@ -56,6 +57,12 @@ class ElasticManager:
         self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
         self.np = np_ if np_ is not None else int(os.getenv(
             "PADDLE_NNODES", "1"))
+        # scale-UP headroom (reference: PADDLE_ELASTIC_NP "min:max" range):
+        # membership scans cover ranks up to max_np so a JOINING node's
+        # heartbeat is visible to watch()/replan()
+        self.max_np = max_np if max_np is not None else int(os.getenv(
+            "PADDLE_ELASTIC_MAX_NP", str(self.np)))
+        self.max_np = max(self.max_np, self.np)
         self.node_rank = node_rank if node_rank is not None else int(
             os.getenv("PADDLE_NODE_RANK", "0"))
         self.heartbeat_interval = heartbeat_interval
@@ -112,7 +119,7 @@ class ElasticManager:
     def alive_nodes(self) -> List[int]:
         now = time.monotonic()
         alive = []
-        for r in range(self.np):
+        for r in range(self.max_np):
             try:
                 raw = self.store.get(self._k("hb", r), wait=False)
             except KeyError:
@@ -145,6 +152,24 @@ class ElasticManager:
             self._last_alive = alive
             return ElasticStatus.RESTART
         return ElasticStatus.HOLD
+
+    # -- re-planning ----------------------------------------------------------
+    def replan(self) -> Dict:
+        """Recompute the topology after a RESTART (reference
+        manager.py:130: the trainer list is REWRITTEN on scale-up/down, not
+        merely restarted at the old world size).
+
+        Dense re-rank of the currently-alive nodes: returns
+        ``{"np": new_world, "nodes": [old ranks alive], "rank_map":
+        {old: new}, "my_rank": new rank or None}`` — ``my_rank is None``
+        means this node was evicted (or died) and must exit.  The caller
+        relaunches its workers with the new world size/endpoints and
+        resumes from the newest checkpoint (incubate.checkpoint).
+        """
+        alive = sorted(self.alive_nodes())
+        rank_map = {old: new for new, old in enumerate(alive)}
+        return {"np": len(alive), "nodes": alive, "rank_map": rank_map,
+                "my_rank": rank_map.get(self.node_rank)}
 
     # -- convenience ----------------------------------------------------------
     def wait_for_np(self, timeout: float = 60.0) -> bool:

@@ -232,6 +232,49 @@ def load(path, **config):
     return TranslatedLayer(predictor)
 
 
+import re as _re
+
+_LAYER_IDX_RE = _re.compile(r"\.(\d+)\.")
+
+
+def _stack_layout(params):
+    """Group parameter names that differ only in ONE numeric segment (the
+    repeated-layer index, e.g. ``gpt.h.{0..23}.attn.qkv_proj.weight``) and
+    whose shapes match.  Returns {template: [names in index order]} for
+    groups of size > 1.
+
+    Rationale: holding each of a deep model's ~300 per-layer params as its
+    own array makes the optimizer update ~300 small XLA fusions running at
+    ~250 GB/s where stacked (L, ...) arrays run at ~700 GB/s.  MEASURED
+    OUTCOME (PERF.md): the per-layer slice views' grad transpose costs more
+    than the update saves on the GPT-2 345M bench (49.8k vs 52.2k
+    tokens/s), so TrainStep(stack_layers=...) defaults OFF; the machinery
+    stays as an opt-in for shapes where the trade goes the other way.  The
+    stack is INTERNAL to TrainStep: state_dict()/sync_to_model still speak
+    per-layer names.
+    """
+    groups = {}
+    for name, arr in params.items():
+        hits = _LAYER_IDX_RE.findall(name)
+        if len(hits) != 1:
+            continue
+        template = _LAYER_IDX_RE.sub(".#.", name)
+        groups.setdefault(template, []).append((int(hits[0]), name))
+    layout = {}
+    for template, members in groups.items():
+        if len(members) < 2:
+            continue
+        members.sort()
+        idxs = [i for i, _n in members]
+        names = [n for _i, n in members]
+        shapes = {params[n].shape for n in names}
+        dtypes = {params[n].dtype for n in names}
+        if idxs == list(range(len(idxs))) and len(shapes) == 1 \
+                and len(dtypes) == 1:
+            layout[template] = names
+    return layout
+
+
 class TrainStep:
     """One fused, compiled training step: forward + backward + optimizer.
 
@@ -254,7 +297,8 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  num_inputs: int = 1, in_shardings=None, donate=True,
-                 zero_stage: Optional[int] = None, zero_axis: str = "sdp"):
+                 zero_stage: Optional[int] = None, zero_axis: str = "sdp",
+                 stack_layers: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -277,6 +321,13 @@ class TrainStep:
         # twice per step — neither buffer can donation-alias the other —
         # measured ~15 ms/step of pure copies on the GPT-2 345M bench
         # (PERF.md "copy lane").
+        # stack layout computed on ORIGINAL dtypes: groups whose members
+        # mix dtypes (e.g. a partially AMP-decorated layer list) fail the
+        # uniformity check here and stay unstacked — after the f32 master
+        # promotion below everything is f32 and the mix would be invisible
+        self._stack = _stack_layout(self.params) if stack_layers else {}
+        self._stacked_names = {n for names in self._stack.values()
+                               for n in names}
         self._compute_dtypes = {}
         if getattr(optimizer, "_multi_precision", None) is not False:
             for k, v in list(self.params.items()):
@@ -284,6 +335,16 @@ class TrainStep:
                                                        jnp.float16):
                     self._compute_dtypes[k] = v.dtype
                     self.params[k] = v.astype(jnp.float32)
+        for template, names in self._stack.items():
+            self.params[template] = jnp.stack(
+                [self.params.pop(n) for n in names])
+            if names[0] in self._compute_dtypes:
+                # sound: the layout's dtype-uniformity check (pre-promotion)
+                # guarantees every member shared names[0]'s compute dtype
+                self._compute_dtypes[template] = self._compute_dtypes[
+                    names[0]]
+                for n in names:
+                    self._compute_dtypes.pop(n, None)
         self.opt_state = optimizer.init_state(self.params)
         self._dirty = True
 
@@ -349,6 +410,15 @@ class TrainStep:
                 params = {k: (p.astype(self._compute_dtypes[k])
                               if k in self._compute_dtypes else p)
                           for k, p in params.items()}
+            if self._stack:
+                # stacked (L, ...) -> per-layer views for functional_call;
+                # the slices are free and their vjp writes each layer's
+                # grad into one stacked buffer
+                params = dict(params)
+                for template, names in self._stack.items():
+                    stacked = params.pop(template)
+                    for i, n in enumerate(names):
+                        params[n] = stacked[i]
             state = {**params, **buffers}
             self.model.train()
             inputs = batch[:self.num_inputs]
@@ -425,11 +495,38 @@ class TrainStep:
                 pass
         return Tensor(loss)
 
+    def _unstacked_params(self):
+        """self.params with stacked groups expanded back to per-layer names
+        (the external contract; lazily-sliced views, no copies)."""
+        params = dict(self.params)
+        for template, names in self._stack.items():
+            stacked = params.pop(template)
+            for i, n in enumerate(names):
+                params[n] = stacked[i]
+        return params
+
+    def _restacked(self, params):
+        """Inverse of _unstacked_params for incoming per-layer dicts."""
+        params = dict(params)
+        for template, names in self._stack.items():
+            if template in params:
+                continue      # already stacked (same-format checkpoint)
+            if all(n in params for n in names):
+                params[template] = jnp.stack(
+                    [jnp.asarray(params.pop(n)) for n in names])
+        return params
+
     def sync_to_model(self):
         """Write the trained arrays back into the eager model."""
-        params = {k: (v.astype(self._compute_dtypes[k])
-                      if k in self._compute_dtypes else v)
-                  for k, v in self.params.items()}
+        params = {k: (v.astype(self._compute_dtypes.get(k, v.dtype))
+                      if hasattr(v, "dtype") else v)
+                  for k, v in self._unstacked_params().items()}
+        # per-name compute dtypes were collapsed onto the template; map back
+        for template, names in self._stack.items():
+            if template in self._compute_dtypes:
+                for n in names:
+                    params[n] = params[n].astype(
+                        self._compute_dtypes[template])
         self.model.load_functional_state({**params, **self.buffers})
         self._dirty = False
 
@@ -441,15 +538,33 @@ class TrainStep:
         lr = self.optimizer._learning_rate
         if hasattr(lr, "state_dict"):
             opt_extra["lr_scheduler"] = lr.state_dict()
-        return {"params": self.params, "buffers": self.buffers,
-                "opt_state": self.opt_state, "opt_extra": opt_extra}
+        # params AND optimizer slots exported UNSTACKED (per-layer names)
+        # so the checkpoint format is independent of the internal stacking
+        # optimization
+        opt_state = self.opt_state
+        if self._stack and isinstance(opt_state, dict) \
+                and "slots" in opt_state:
+            slots = dict(opt_state["slots"])
+            for template, names in self._stack.items():
+                if template not in slots:
+                    continue
+                grp = slots.pop(template)
+                for i, n in enumerate(names):
+                    slots[n] = {k: v[i] for k, v in grp.items()}
+            opt_state = {**opt_state, "slots": slots}
+        return {"params": self._unstacked_params(), "buffers": self.buffers,
+                "opt_state": opt_state, "opt_extra": opt_extra}
 
     def set_state_dict(self, state):
         """Restore from :meth:`state_dict` output.  Arrays are re-placed on
         their current shardings (ZeRO layouts survive a restore)."""
         def place_like(new, old):
             if hasattr(old, "sharding") and hasattr(new, "shape"):
-                arr = jnp.asarray(new)
+                # COPY (jnp.array), never alias: the incoming state may
+                # reference another live TrainStep's buffers (state_dict
+                # returns views), and this step's donation would delete
+                # them out from under their owner
+                arr = jnp.array(new)
                 if hasattr(old, "dtype") and arr.dtype != old.dtype:
                     # e.g. a bf16 model-side save restored into the fp32
                     # master param state
@@ -457,11 +572,24 @@ class TrainStep:
                 return jax.device_put(arr, old.sharding)
             return new
         self.params = {k: place_like(v, self.params.get(k))
-                       for k, v in state["params"].items()}
+                       for k, v in self._restacked(
+                           state["params"]).items()}
         self.buffers = {k: place_like(v, self.buffers.get(k))
                         for k, v in state["buffers"].items()}
+        opt_state = state["opt_state"]
+        if self._stack and isinstance(opt_state, dict) \
+                and "slots" in opt_state:
+            slots = dict(opt_state["slots"])
+            for template, names in self._stack.items():
+                if template in slots or not all(n in slots for n in names):
+                    continue
+                per = [slots.pop(n) for n in names]
+                slots[template] = {
+                    k: jnp.stack([jnp.asarray(p[k]) for p in per])
+                    for k in per[0]}
+            opt_state = {**opt_state, "slots": slots}
         self.opt_state = jax.tree_util.tree_map(
-            place_like, state["opt_state"], self.opt_state)
+            place_like, opt_state, self.opt_state)
         lr = self.optimizer._learning_rate
         sched = state.get("opt_extra", {}).get("lr_scheduler")
         if sched is not None and hasattr(lr, "set_state_dict"):

@@ -118,8 +118,11 @@ def _pick_head_group(h: int, d: int, s: int):
     for hg in groups:            # largest first
         if hg * d <= 256 and bwd_fits(hg):
             return hg
-    # nothing fits: smallest aligned group is the best effort
-    # (supported() gates longer sequences off this path entirely)
+    # no group fits the merged backward's full-seq scratch: the SPLIT
+    # backward (O(block) VMEM) takes over — pick by block size alone
+    for hg in groups:
+        if hg * d <= 256:
+            return hg
     return groups[-1]
 
 
@@ -159,12 +162,12 @@ def _pick_fwd_head_group(h: int, d: int, s: int, hg_b: int) -> int:
 
 
 def max_supported_seq(h: int, d: int) -> int:
-    """Longest sequence the Pallas path supports end-to-end — bounded by
-    the backward's full-sequence dq scratch at the smallest aligned head
-    group (the forward streams K/V blocks for long sequences, so it is not
-    the binding constraint).  Used by kernels.flash_attention.supported."""
-    hgd = _aligned_groups(h, d)[-1] * d
-    return (_DQ_SCRATCH_BUDGET // (hgd * 4)) // 128 * 128
+    """Longest sequence the Pallas path supports end-to-end.  With the
+    split two-kernel backward (O(block) VMEM) the sequence length is no
+    longer VMEM-bound; the cap below is the point where the per-row lse
+    bookkeeping itself (b*h*s f32) stops being sensible on one chip —
+    beyond it the sequence axis should shard (ring/Ulysses, SURVEY §5.7)."""
+    return 256 * 1024
 
 
 # ---------------------------------------------------------------------------
@@ -470,9 +473,187 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (jnp.float32(scale) * dq_sc[...]).astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc, *, causal, scale, hg, d, nk):
+    """dQ-only backward for LONG sequences: grid (b, n_hg, nq, nk) with ki
+    innermost, so dq accumulates in a BLOCK-sized scratch (no full-sequence
+    scratch — the merged kernel's 16k+ VMEM blocker, PERF.md)."""
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    qi = _pid(2)
+    ki = _pid(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    live = True
+    if causal:
+        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
+            jax.lax.mul(ki, _i32(block_k))
+
+    @pl.when(live)
+    def _compute():
+        if causal:
+            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = col_ids <= row_ids
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # base-2
+            delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            p = jnp.exp2(logits - lse[:, None])
+            if causal:
+                p = jnp.where(mask, p, jnp.float32(0.0))
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dq_sc[:, sl] = dq_sc[:, sl] + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (jnp.float32(scale) * dq_sc[...]).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, causal, scale, hg, d,
+                    nq):
+    """dK/dV backward (ki outer, qi inner) — the merged kernel minus the
+    full-sequence dq scratch; pairs with _bwd_dq_kernel for long seqs."""
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    ki = _pid(2)
+    qi = _pid(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    live = True
+    if causal:
+        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
+            jax.lax.mul(ki, _i32(block_k))
+
+    @pl.when(live)
+    def _compute():
+        if causal:
+            row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = col_ids <= row_ids
+        for hh in range(hg):
+            sl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]
+            delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            p = jnp.exp2(logits - lse[:, None])
+            if causal:
+                p = jnp.where(mask, p, jnp.float32(0.0))
+            pc = p.astype(do.dtype)
+            dv_sc[:, sl] = dv_sc[:, sl] + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dk_sc[:, sl] = dk_sc[:, sl] + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = (jnp.float32(scale) * dk_sc[...]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
+                     block_k, hg, d, interpret):
+    """Two-kernel backward with O(block) VMEM — the long-sequence path
+    (the merged kernel's full-sequence dq scratch caps it at ~8k tokens).
+    Costs one extra recompute of the logits/dP matmuls per block pair."""
+    b, s, hd = q3.shape
+    sk = k3.shape[1]
+    h = hd // d
+    n_hg = h // hg
+    nq = s // block_q
+    nk = sk // block_k
+    hgd = hg * d
+    delta = jnp.sum(
+        do3.reshape(b, s, h, d).astype(jnp.float32) *
+        o3.reshape(b, s, h, d).astype(jnp.float32), axis=-1)
+    delta = jnp.moveaxis(delta, -1, 1).reshape(b, n_hg, hg, nq, block_q)
+
+    row_spec = pl.BlockSpec((1, 1, hg, nq, block_q),
+                            lambda bi, g, i, j: (bi, g, 0, 0, 0))
+    q_spec_qout = pl.BlockSpec((1, block_q, hgd),
+                               lambda bi, g, i, j: (bi, i, g))
+    kv_spec_qout = pl.BlockSpec((1, block_k, hgd),
+                                lambda bi, g, i, j: (bi, j, g))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          hg=hg, d=d, nk=nk),
+        grid=(b, n_hg, nq, nk),
+        in_specs=[q_spec_qout, kv_spec_qout, kv_spec_qout, q_spec_qout,
+                  row_spec, row_spec],
+        out_specs=q_spec_qout,
+        out_shape=jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hgd), jnp.float32)],
+        compiler_params=_SEQ2,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    q_spec_kout = pl.BlockSpec((1, block_q, hgd),
+                               lambda bi, g, i, j: (bi, j, g))
+    kv_spec_kout = pl.BlockSpec((1, block_k, hgd),
+                                lambda bi, g, i, j: (bi, i, g))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          hg=hg, d=d, nq=nq),
+        grid=(b, n_hg, nk, nq),
+        in_specs=[q_spec_kout, kv_spec_kout, kv_spec_kout, q_spec_kout,
+                  row_spec, row_spec],
+        out_specs=[kv_spec_kout, kv_spec_kout],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, hd), k3.dtype),
+                   jax.ShapeDtypeStruct((b, sk, hd), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, hgd), jnp.float32),
+                        pltpu.VMEM((block_k, hgd), jnp.float32)],
+        compiler_params=_SEQ2,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
                hg, d, interpret=False):
     with jax.enable_x64(False):
+        s = max(q3.shape[1], k3.shape[1])
+        if s * hg * d * 4 > _DQ_SCRATCH_BUDGET:
+            # long sequence: the merged kernel's full-seq dq scratch would
+            # blow VMEM — take the split two-kernel path
+            return _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal,
+                                    scale, block_q, block_k, hg, d,
+                                    interpret)
         return _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale,
                                 block_q, block_k, hg, d, interpret)
 

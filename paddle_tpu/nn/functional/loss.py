@@ -81,6 +81,8 @@ def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
     # ~10 ms/step of HBM traffic); with per-consumer converts the bf16
     # matmul output is the only materialised array and each streaming
     # reduction fuses its own upcast
+    # (a max-free clamped variant was benched and measured no faster —
+    # XLA's two streaming reductions are not the bottleneck they look like)
     m = jax.lax.stop_gradient(jnp.max(logits, axis=axis))
     mf = m.astype(jnp.float32)
     lse = mf + jnp.log(jnp.sum(

@@ -83,8 +83,14 @@ def save_inference_model(path_prefix, feed_vars=None, fetch_vars=None,
         pickle.dump({k: np.asarray(v) for k, v in state.items()}, f)
     feed_names = [getattr(s, "name", None) or f"x{i}"
                   for i, s in enumerate(input_spec or [])]
+    # fetch names for the Executor.run triple contract: one per flattened
+    # output leaf (the analogue of the reference's fetch_vars names)
+    out_shape = jax.eval_shape(jitted, state, *specs)
+    n_out = len(jax.tree_util.tree_leaves(out_shape))
+    fetch_names = [f"fetch_{i}" for i in range(n_out)]
     meta = {"input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
             "feed_names": feed_names,
+            "fetch_names": fetch_names,
             "format_version": 1}
     with open(path_prefix + ".pdmodel.meta", "wb") as f:
         pickle.dump(meta, f)
@@ -95,10 +101,11 @@ class _Predictor:
     """Executable predictor over a deserialized exported module (the
     AnalysisPredictor analogue, analysis_predictor.h:90/:132)."""
 
-    def __init__(self, fn, state, feed_names=None):
+    def __init__(self, fn, state, feed_names=None, fetch_names=None):
         self._fn = fn
         self._state = state
         self.feed_names = list(feed_names or [])
+        self.fetch_names = list(fetch_names or [])
 
     @staticmethod
     def _unwrap_feeds(feeds):
@@ -143,8 +150,9 @@ def load_inference_model(path_prefix, executor=None, model=None, **kwargs):
         with open(path_prefix + ".pdmodel.meta", "rb") as f:
             meta = pickle.load(f)
         feed_names = list(meta.get("feed_names", []))
+        fetch_names = list(meta.get("fetch_names", []))
     except OSError:
-        feed_names = []
+        feed_names, fetch_names = [], []
     if model is not None:
         from ..jit import functional_call
         model.eval()
@@ -154,17 +162,20 @@ def load_inference_model(path_prefix, executor=None, model=None, **kwargs):
             out, _ = functional_call(model, state, *args)
             return out
 
-        predictor = _Predictor(fwd, state, feed_names)
+        predictor = _Predictor(fwd, state, feed_names, fetch_names)
     else:
         from jax import export as jexport
         with open(path_prefix + ".pdmodel", "rb") as f:
             exported = jexport.deserialize(bytearray(f.read()))
-        predictor = _Predictor(jax.jit(exported.call), state, feed_names)
+        predictor = _Predictor(jax.jit(exported.call), state, feed_names,
+                               fetch_names)
     if executor is not None:
         # reference triple contract (static/io.py:681): the caller does
         # [prog, feeds, fetches] = load_inference_model(path, exe);
-        # exe.run(prog, feed={...}, fetch_list=fetches)
-        return [predictor, predictor.feed_names, ["__fetch__"]]
+        # exe.run(prog, feed={...}, fetch_list=fetches) — fetches are the
+        # REAL recorded output names, selectable individually
+        return [predictor, predictor.feed_names,
+                list(predictor.fetch_names)]
     return predictor
 
 
@@ -241,7 +252,22 @@ class Executor:
                 names = list(feed or {})
             feeds = [feed[n] for n in names] if feed else []
             outs = program.run(feeds)
-            return [np.asarray(o._array) for o in outs]
+            arrs = [np.asarray(o._array) for o in outs]
+            if fetch_list:
+                # map requested fetch names to recorded output positions
+                fnames = program.fetch_names or [
+                    f"fetch_{i}" for i in range(len(arrs))]
+                pos = {n: i for i, n in enumerate(fnames)}
+                sel = []
+                for want in fetch_list:
+                    name = getattr(want, "name", want)
+                    if name not in pos:
+                        raise KeyError(
+                            "fetch %r not among this artifact's outputs %r"
+                            % (name, fnames))
+                    sel.append(arrs[pos[name]])
+                return sel
+            return arrs
         raise NotImplementedError(
             "Executor.run executes loaded inference programs; for training "
             "use paddle_tpu.jit.to_static / TrainStep (SURVEY.md §7 table).")

@@ -73,3 +73,50 @@ def test_trainstep_o2_master_weights():
     # syncing back restores the model's bf16 params
     step.sync_to_model()
     assert m.weight.dtype == paddle.bfloat16
+
+
+def test_trainstep_layer_stacking_parity():
+    """The internal stacked-params optimization (TrainStep stack_layers)
+    must be invisible: identical losses to the unstacked step, per-layer
+    state_dict keys, and a state_dict round-trip across modes."""
+    import numpy as np
+
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    def build():
+        paddle.seed(3)
+        return GPTForCausalLM(GPTConfig.tiny())
+
+    crit = GPTPretrainingCriterion()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 32)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+
+    losses = {}
+    steps = {}
+    for mode in (True, False):
+        m = build()
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=1e-3)
+        step = TrainStep(m, lambda lg, lb: crit(lg, lb), opt,
+                         stack_layers=mode)
+        losses[mode] = [float(step(x, x).numpy()) for _ in range(4)]
+        steps[mode] = step
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=2e-5, atol=1e-6)
+    # the stacked step really grouped the 2 blocks' params
+    assert steps[True]._stack and not steps[False]._stack
+    # external contract: state_dict speaks per-layer names in both modes
+    sdT = steps[True].state_dict()["params"]
+    sdF = steps[False].state_dict()["params"]
+    assert set(sdT) == set(sdF)
+    for k in sdT:
+        np.testing.assert_allclose(
+            np.asarray(sdT[k], np.float32), np.asarray(sdF[k], np.float32),
+            rtol=2e-4, atol=1e-5, err_msg=k)
+    # round-trip: an unstacked save restores into a stacked step
+    steps[True].set_state_dict(steps[False].state_dict())
+    np.testing.assert_allclose(
+        float(steps[True](x, x).numpy()),
+        float(steps[False](x, x).numpy()), rtol=2e-5, atol=1e-6)

@@ -1,0 +1,57 @@
+"""Long-sequence training probe (PERF.md long-context table).
+
+Usage: python tools/longseq_bench.py <seq> [batch] [steps]
+GPT-2 345M with max_position_embeddings raised to <seq>, recompute on,
+AMP O2 bf16; prints tokens/s or the failure signature.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt2_medium()
+    cfg.max_position_embeddings = seq
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    cfg.use_recompute = True
+    model = GPTForCausalLM(cfg)
+    paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, lambda lg, lb: crit(lg, lb), opt)
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = jnp.asarray(ids)
+    t0 = time.perf_counter()
+    loss = step(x, x)
+    loss.numpy()
+    print("compile+first step: %.1fs" % (time.perf_counter() - t0))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, x)
+    loss._array.block_until_ready()
+    dt = time.perf_counter() - t0
+    print("seq=%d batch=%d: %.1f tokens/s (loss %.3f)"
+          % (seq, batch, batch * seq * steps / dt, float(loss.numpy())))
+
+
+if __name__ == "__main__":
+    main()
