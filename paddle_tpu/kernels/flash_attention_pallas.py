@@ -67,7 +67,6 @@ _NEG_INF = -1e30
 # units; the backward consumes it with exp2 as well, and d/d(qk) keeps the
 # plain base-e `scale` factor (dS = scale * P * (dP - delta) regardless).
 _LOG2E = 1.4426950408889634
-_LN2 = 0.6931471805599453
 
 _SEQ2 = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"))
@@ -199,12 +198,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
                 k = k_ref[0, pl.ds(start, block_k), sl]
                 v = v_ref[0, pl.ds(start, block_k), sl]
                 # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
-                # operands first quarters matmul throughput.  q arrives
-                # PRE-SCALED by scale*log2(e) (one pass over (b,s,h,d)
-                # instead of a multiply over every (b,h,s^2) logit)
+                # operands first quarters matmul throughput
                 logits = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+                    preferred_element_type=jnp.float32) * \
+                    jnp.float32(scale * _LOG2E)
                 if masked:
                     col_ids = start[None, None] + \
                         jax.lax.broadcasted_iota(
@@ -273,11 +271,12 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc
             q = q_ref[0, :, sl]                               # (BQ, D)
             k = k_ref[0, :, sl]                               # (BK, D)
             v = v_ref[0, :, sl]
-            # bf16 x bf16 -> f32 is the MXU's native mode; q PRE-SCALED
-            # by scale*log2(e) (see the resident kernel)
+            # bf16 x bf16 -> f32 is the MXU's native mode; upcasting
+            # operands first quarters matmul throughput
             logits = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32) * \
+                jnp.float32(scale * _LOG2E)
             if masked:
                 logits = jnp.where(mask, logits, jnp.float32(_NEG_INF))
             m = m_sc[hh]
@@ -326,19 +325,13 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc
 
 
 
-def _prescale_q(q3, scale):
-    # fold scale*log2(e) into q ONCE ((b,s,h*d) elements) instead of
-    # multiplying every (b,h,s^2) logit inside the kernels
-    return (q3 * jnp.asarray(scale * _LOG2E, q3.dtype)).astype(q3.dtype)
-
-
 def _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
                interpret=False):
     # trace with x64 off: the global x64 mode (needed for paddle's int64
     # semantics) surfaces i64/f64 intermediates that mosaic cannot lower
     with jax.enable_x64(False):
-        return _flash_fwd_inner(_prescale_q(q3, scale), k3, v3, causal,
-                                scale, block_q, block_k, hg, d, interpret)
+        return _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k,
+                                hg, d, interpret)
 
 
 def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
@@ -445,8 +438,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do = do_ref[0, :, sl]
             lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # (BQ,) f32, base-2
             delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]  # (BQ,) f32
-            # q is PRE-SCALED by scale*log2(e): logits are base-2 directly
-            logits = jax.lax.dot_general(
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)          # (BQ, BK)
             p = jnp.exp2(logits - lse[:, None])
@@ -473,9 +465,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize_kv():
-        # dS^T was accumulated against the PRE-SCALED q (= scale*log2e*q),
-        # so dk needs ln2 to land at the base-e scale*dS^T@q total
-        dk_ref[0] = (jnp.float32(_LN2) * dk_sc[...]).astype(dk_ref.dtype)
+        dk_ref[0] = (jnp.float32(scale) * dk_sc[...]).astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
     @pl.when(jnp.logical_and(ki == nk - 1, qi == nq - 1))
@@ -518,8 +508,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do = do_ref[0, :, sl]
             lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]      # base-2
             delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]
-            # q PRE-SCALED by scale*log2(e): logits are base-2 directly
-            logits = jax.lax.dot_general(
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             p = jnp.exp2(logits - lse[:, None])
@@ -574,8 +563,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do = do_ref[0, :, sl]
             lse = lse_ref[0, 0, hh, pl.ds(qi, 1), :][0]
             delta = delta_ref[0, 0, hh, pl.ds(qi, 1), :][0]
-            # q PRE-SCALED by scale*log2(e): logits are base-2 directly
-            logits = jax.lax.dot_general(
+            logits = jnp.float32(scale * _LOG2E) * jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             p = jnp.exp2(logits - lse[:, None])
@@ -595,8 +583,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        # dS^T accumulated against the PRE-SCALED q: x ln2 (see merged bwd)
-        dk_ref[0] = (jnp.float32(_LN2) * dk_sc[...]).astype(dk_ref.dtype)
+        dk_ref[0] = (jnp.float32(scale) * dk_sc[...]).astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
@@ -660,7 +647,6 @@ def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
                hg, d, interpret=False):
     with jax.enable_x64(False):
-        q3 = _prescale_q(q3, scale)
         s = max(q3.shape[1], k3.shape[1])
         if s * hg * d * 4 > _DQ_SCRATCH_BUDGET:
             # long sequence: the merged kernel's full-seq dq scratch would
