@@ -253,3 +253,36 @@ def test_sequential_and_layerlist():
     assert len(list(ll)) == 3
     ll.append(nn.Linear(2, 2))
     assert len(ll) == 4
+
+
+def test_gpt_incremental_decode_matches_full_forward():
+    """KV-cache decode (GPTForCausalLM cache path): feeding tokens one at a
+    time through gen_cache must reproduce the full-context logits at every
+    position (the inference decode contract; reference MultiHeadAttention
+    Cache semantics)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(5)
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                           (2, 12)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    full_logits = m(x).numpy()                      # (2, 12, V)
+
+    cache = m.gen_cache(batch_size=2, dtype="float32")
+    step_logits = []
+    for t in range(ids.shape[1]):
+        tok = paddle.to_tensor(ids[:, t:t + 1])
+        logits, cache = m(tok, cache=cache)
+        step_logits.append(np.asarray(logits.numpy())[:, 0, :])
+    inc = np.stack(step_logits, axis=1)             # (2, 12, V)
+    np.testing.assert_allclose(inc, np.asarray(full_logits),
+                               rtol=2e-4, atol=2e-4)
+    # greedy continuation agrees with the full-context argmax
+    assert np.array_equal(inc[:, -1, :].argmax(-1),
+                          np.asarray(full_logits)[:, -1, :].argmax(-1))
