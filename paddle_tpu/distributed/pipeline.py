@@ -364,8 +364,9 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
     over (dp×pp composition in ONE program, reference 4-D topology
     fleet/base/topology.py:54): the loss is additionally averaged and every
     grad psum'd over them.  Tensor-parallel axes need no declaration here —
-    mp collectives live inside block_fn/head_loss_fn (use
-    :func:`megatron_input` at column-parallel block entries).
+    the forward mp collectives live inside block_fn/head_loss_fn, and the
+    backward input-edge allreduce is inserted by jax's vma-typed autodiff
+    (see the NOTE above — do NOT hand-write the Megatron 'f' operator).
 
     Returns (mean_loss, grads) with grads matching the params structure
     (blocks grads carry the local leading stage dim of 1).
@@ -407,6 +408,11 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
     x0 = raw_mb(0)
     h_shape = jax.eval_shape(embed_fn, embed_p, x0)
 
+    def masked_add(acc_tree, d_tree, keep):
+        return jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(keep, d.astype(a.dtype), 0.0),
+            acc_tree, d_tree)
+
     def tick(t, carry):
         (fwd_buf, bwd_buf, ring, g_embed, g_blocks, g_head, loss_acc) = carry
 
@@ -428,14 +434,9 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
         (loss_f, (dhead_f, dembed_hf, ct_seed)) = jax.value_and_grad(
             lambda hp, ep, o: head_loss_fn(hp, ep, o, label_mb(f)),
             argnums=(0, 1, 2))(head_p, embed_p, out.astype(jnp.float32))
-        keep_l = is_last_f.astype(loss_f.dtype)
-        loss_acc = loss_acc + loss_f * keep_l
-        g_head = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(is_last_f, d.astype(a.dtype), 0.0),
-            g_head, dhead_f)
-        g_embed = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(is_last_f, d.astype(a.dtype), 0.0),
-            g_embed, dembed_hf)
+        loss_acc = loss_acc + loss_f * is_last_f.astype(loss_f.dtype)
+        g_head = masked_add(g_head, dhead_f, is_last_f)
+        g_embed = masked_add(g_embed, dembed_hf, is_last_f)
 
         # ---- backward -----------------------------------------------------
         b = t - 2 * (n - 1) + stage
@@ -445,16 +446,12 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
         ct_in = jnp.where(stage == n - 1, ct_seed.astype(out.dtype), bwd_buf)
         _, vjp = jax.vjp(stage_fwd, blocks_p, x_b)
         dblocks, dx = vjp(ct_in.astype(out.dtype))
-        g_blocks = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(b_valid, d.astype(a.dtype), 0.0),
-            g_blocks, dblocks)
+        g_blocks = masked_add(g_blocks, dblocks, b_valid)
         # stage 0 continues the chain into the embedding for microbatch b
         is_first_b = jnp.logical_and(b_valid, stage == 0)
         _, vjp_e = jax.vjp(lambda ep: embed_fn(ep, raw_mb(b)), embed_p)
         (dembed_b,) = vjp_e(dx.astype(h_shape.dtype))
-        g_embed = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(is_first_b, d.astype(a.dtype), 0.0),
-            g_embed, dembed_b)
+        g_embed = masked_add(g_embed, dembed_b, is_first_b)
 
         fwd_buf = jax.lax.ppermute(out, axis, fwd_perm)
         bwd_buf = jax.lax.ppermute(dx, axis, bwd_perm)
@@ -603,7 +600,7 @@ class _CompiledPipelineStep:
         loss = self._loss_layer(Tensor(out), Tensor(lbl))
         return loss._array if isinstance(loss, Tensor) else loss
 
-    def _build(self, x_shape, x_dtype, y_shape, y_dtype):
+    def _build(self):
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
 
@@ -650,7 +647,7 @@ class _CompiledPipelineStep:
         x_a = x_a.reshape((m, mb) + x_a.shape[1:])
         y_a = y_a.reshape((m, mb) + y_a.shape[1:])
         if self._step is None:
-            self._build(x_a.shape, x_a.dtype, y_a.shape, y_a.dtype)
+            self._build()
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         loss, self.params, self.opt_state = self._step(
             self.params, self.opt_state, lr, x_a, y_a)
