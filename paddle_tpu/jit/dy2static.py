@@ -302,8 +302,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             raise Dy2StaticUnsupportedError(
                 "break/continue/return inside a converted while loop; "
                 "restructure or use static.nn.while_loop directly")
-        carried = sorted(_store_names(node.body)
-                         | (_store_names(node.body) & _load_names(node.test)))
+        carried = sorted(_store_names(node.body))
         if not carried:
             raise Dy2StaticUnsupportedError(
                 "while body assigns no variables — infinite or effect-only "

@@ -93,14 +93,15 @@ def test_shared_desc_ties_weights_eager():
     assert len(ids) == len(set(ids))
 
 
-def test_fleet_pp_compiled_1f1b_tied_embeddings():
+@pytest.mark.parametrize("pp,dp", [(4, 2), (8, 1)])
+def test_fleet_pp_compiled_1f1b_tied_embeddings(pp, dp):
     import jax
-    if len(jax.devices()) < 4:
-        pytest.skip("needs 4 devices")
+    if len(jax.devices()) < pp * dp:
+        pytest.skip("needs %d devices" % (pp * dp))
 
     # ---- sequential eager reference (same seed, same microbatching) ------
     paddle.seed(11)
-    ref = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+    ref = PipelineLayer(_descs(), num_stages=pp, loss_fn=Criterion())
     ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
                                    parameters=ref.parameters())
     acc = 4
@@ -121,12 +122,12 @@ def test_fleet_pp_compiled_1f1b_tied_embeddings():
 
     # ---- compiled 1F1B through the fleet API -----------------------------
     strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+    strategy.hybrid_configs = {"pp_degree": pp, "dp_degree": dp}
     strategy.pipeline_configs = {"accumulate_steps": acc}
     fleet.init(is_collective=True, strategy=strategy)
 
     paddle.seed(11)
-    pl = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+    pl = PipelineLayer(_descs(), num_stages=pp, loss_fn=Criterion())
     model = fleet.distributed_model(pl)
     assert isinstance(model, PipelineParallel)
     opt = paddle.optimizer.SGD(learning_rate=0.1,
