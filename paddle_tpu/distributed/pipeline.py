@@ -580,7 +580,35 @@ class _CompiledPipelineStep:
             "head": {k: jax.device_put(v, rep) for k, v in head_p.items()},
         }
         self.opt_state = optimizer.init_state(self.params)
-        self.opt_state = jax.device_put(self.opt_state)  # replicate slots
+        # ZeRO-1 x pipeline (the reference's full 4-D [data, pipe,
+        # sharding, model] topology, fleet/base/topology.py:54): with an
+        # 'sdp' mesh axis the optimizer slots shard over it — the update
+        # runs OUTSIDE the shard_map in the same jitted program, so GSPMD
+        # partitions it against the slot layout exactly as
+        # TrainStep(zero_stage=1) does
+        self._sdp = dict(zip(self._mesh.axis_names,
+                             self._mesh.devices.shape)).get("sdp", 1)
+        if self._sdp > 1:
+            from .sharding import _stage_spec_for, shard_optimizer_state
+
+            def place_block(leaf):
+                # block slots keep the stage dim on 'pp' AND shard the
+                # largest remaining divisible dim over 'sdp' (same pick +
+                # min-size policy as the plain ZeRO-1 layout)
+                if not (hasattr(leaf, "ndim") and leaf.ndim > 0):
+                    return leaf
+                return jax.device_put(leaf, NamedSharding(
+                    self._mesh,
+                    _stage_spec_for(leaf, "sdp", fixed=("pp",))))
+
+            slots = self.opt_state["slots"]
+            slots = {"embed": shard_optimizer_state(slots["embed"], "sdp"),
+                     "blocks": jax.tree_util.tree_map(place_block,
+                                                      slots["blocks"]),
+                     "head": shard_optimizer_state(slots["head"], "sdp")}
+            self.opt_state = {**self.opt_state, "slots": slots}
+        else:
+            self.opt_state = jax.device_put(self.opt_state)  # replicate
         self._step = None
 
     # -- functional wrappers ------------------------------------------------

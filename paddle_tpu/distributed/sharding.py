@@ -21,19 +21,22 @@ from ..nn.layer.layers import Layer
 from . import mesh as _mesh
 
 
-def _stage_spec_for(arr, axis: str, min_size=2 ** 12):
+def _stage_spec_for(arr, axis: str, min_size=2 ** 12, fixed=()):
     """Shard the largest divisible dim of `arr` over `axis` (ZeRO slicing is
     layout-free in the reference; on TPU we pick a dim so XLA keeps layouts
-    tileable)."""
+    tileable).  ``fixed`` pins the leading dims to the given axis names
+    (e.g. ("pp",) for pipeline-stacked slots) — those dims keep their
+    sharding and are excluded from the pick."""
     n = _mesh.axis_size(axis)
+    base = list(fixed) + [None] * (arr.ndim - len(fixed))
     if n <= 1 or arr.size < min_size:
-        return PartitionSpec()
-    for d in np.argsort(arr.shape)[::-1]:
+        return PartitionSpec(*base) if fixed else PartitionSpec()
+    free = [d for d in np.argsort(arr.shape)[::-1] if d >= len(fixed)]
+    for d in free:
         if arr.shape[d] % n == 0:
-            spec = [None] * arr.ndim
-            spec[int(d)] = axis
-            return PartitionSpec(*spec)
-    return PartitionSpec()
+            base[int(d)] = axis
+            return PartitionSpec(*base)
+    return PartitionSpec(*base) if fixed else PartitionSpec()
 
 
 def shard_optimizer_state(opt_state, axis="sdp"):

@@ -191,11 +191,6 @@ def _store_names(stmts) -> set:
     return names
 
 
-def _load_names(node) -> set:
-    return {n.id for n in ast.walk(node)
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
-
-
 def _has_stmt(stmts, kinds) -> bool:
     return any(isinstance(node, kinds)
                for st in stmts for node in ast.walk(st))
