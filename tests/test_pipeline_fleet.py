@@ -218,3 +218,35 @@ def test_fleet_pp_with_zero1_sharding_4d():
         assert any(sharded), 'no block slot sharded over sdp'
     finally:
         mesh_mod.init_mesh({"dp": 1})
+
+
+def test_fleet_pp_compiled_bf16_master_weights():
+    """AMP O2 bf16 params through the compiled pipeline: the optimizer's
+    fp32 master slots (optimizer.py _init_slots) must keep sub-ULP updates
+    accumulating — loss decreases over steps that would stall in pure
+    bf16."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    import jax.numpy as jnp
+
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        paddle.seed(11)
+        pl = PipelineLayer(_descs(), num_stages=4, loss_fn=Criterion())
+        paddle.amp.decorate(pl, level="O2", dtype="bfloat16")
+        model = PipelineParallel(pl)
+        model.accumulate_steps = 4
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=5e-3)
+        losses = [float(model.train_batch((x, y), opt).numpy())
+                  for x, y in _data(4)]
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]
+        # bf16 params carried master slots in the compiled state
+        slots = model._compiled.opt_state["slots"]["blocks"]
+        masters = [leaf for slot in slots.values() for k, leaf in
+                   slot.items() if k == "master"]
+        assert masters and all(m.dtype == jnp.float32 for m in masters)
+    finally:
+        mesh_mod.init_mesh({"dp": 1})
