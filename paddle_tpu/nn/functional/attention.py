@@ -58,6 +58,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         dropout_p = 0.0
 
     def raw(q, k, v, m):
+        if m is None and dropout_p == 0.0 and q.ndim == 4 \
+                and q.shape[1] == k.shape[1]:
+            # SEQUENCE-PARALLEL path: inside a shard_map trace with the
+            # 'sep' axis bound (manual sequence sharding), each device
+            # holds a contiguous token shard — attend via ring attention
+            # (ppermute KV rotation over the axis; SURVEY §5.7).  Under
+            # plain pjit/GSPMD 'sep' is not a bound manual axis, so this
+            # never triggers there.
+            from ...distributed.collective import _in_trace
+            if _in_trace("sep"):
+                from ...distributed.ring_attention import ring_attention
+                out = ring_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), "sep", causal=is_causal,
+                    scale=scale)                 # ring is (B, H, S, D)
+                return jnp.swapaxes(out, 1, 2)
         if use_flash and m is None and dropout_p == 0.0:
             from ...kernels import flash_attention as fa
             if fa.supported(q, k):

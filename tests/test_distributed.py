@@ -420,3 +420,42 @@ def test_executor_run_fetch_names(tmp_path):
     import pytest as _pytest
     with _pytest.raises(KeyError):
         exe.run(prog, feed={"x": x}, fetch_list=["nope"])
+
+
+def test_sdpa_routes_to_ring_attention_under_sep():
+    """scaled_dot_product_attention inside a shard_map with the 'sep' axis
+    bound attends via RING attention over the sharded sequence — the model
+    attention layer works on token shards without gathering the sequence
+    (SURVEY §5.7 long-context integration; standalone ring tests above)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    if len(jax.devices()) < 4:
+        import pytest as _pytest
+        _pytest.skip("needs 4 devices")
+    b, s, h, d = 2, 32, 2, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+
+    def attn(q_, k_, v_):
+        out = F.scaled_dot_product_attention(
+            paddle.Tensor(q_), paddle.Tensor(k_), paddle.Tensor(v_),
+            is_causal=True, training=False)
+        return out._array if hasattr(out, "_array") else out
+
+    # unsharded reference (no 'sep' in trace -> flash/XLA path)
+    want = attn(q, k, v)
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+    got = jax.jit(shard_map(
+        attn, mesh=mesh,
+        in_specs=(P(None, "sep"), P(None, "sep"), P(None, "sep")),
+        out_specs=P(None, "sep")))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
