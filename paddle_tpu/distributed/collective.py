@@ -79,8 +79,11 @@ def _axis_of(group) -> str:
     return _default_axis
 
 
-def _in_trace(axis: str) -> bool:
-    """True when `axis` is bound in the current shard_map/pmap trace."""
+def axis_in_trace(axis: str) -> bool:
+    """PUBLIC: True when `axis` is bound as a manual mesh axis in the
+    current shard_map/pmap trace (both directions pinned by
+    tests/test_distributed.py).  Collective dispatch and the
+    sequence-parallel attention routing key on this."""
     try:
         jax.lax.axis_index(axis)
         return True
@@ -88,6 +91,9 @@ def _in_trace(axis: str) -> bool:
         return False
     except Exception:
         return False
+
+
+_in_trace = axis_in_trace  # internal alias (historical name)
 
 
 def new_group(ranks=None, backend=None, axis=None, timeout=None):

@@ -49,25 +49,38 @@ def sdpa_reference_raw(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None,
-                                 use_flash=True):
+                                 use_flash=True, sequence_parallel="auto"):
     """q/k/v: (batch, seq, heads, head_dim) — reference layout
-    (python/paddle incubate FusedMultiHeadAttention input layout)."""
+    (python/paddle incubate FusedMultiHeadAttention input layout).
+
+    SEQUENCE PARALLELISM: inside a shard_map trace with the framework's
+    sequence-parallel axis 'sep' bound, the CONTRACT is that q/k/v are the
+    LOCAL contiguous token shards, and attention runs via the ppermute
+    ring-KV rotation over the axis (SURVEY §5.7).  Shapes/configurations
+    the ring path cannot express there (attn_mask, active dropout, cached
+    decode with q_len != k_len) raise rather than silently attending
+    shard-locally.  Pass ``sequence_parallel=False`` for code inside a
+    'sep' shard_map that has already gathered the full sequence.  Plain
+    pjit/GSPMD traces never bind 'sep' manually and are unaffected.
+    """
     from ...core import random as _rnd
     dropout_key = _rnd.next_key() if (dropout_p > 0.0 and training) else None
     if not training:
         dropout_p = 0.0
 
     def raw(q, k, v, m):
-        if m is None and dropout_p == 0.0 and q.ndim == 4 \
-                and q.shape[1] == k.shape[1]:
-            # SEQUENCE-PARALLEL path: inside a shard_map trace with the
-            # 'sep' axis bound (manual sequence sharding), each device
-            # holds a contiguous token shard — attend via ring attention
-            # (ppermute KV rotation over the axis; SURVEY §5.7).  Under
-            # plain pjit/GSPMD 'sep' is not a bound manual axis, so this
-            # never triggers there.
-            from ...distributed.collective import _in_trace
-            if _in_trace("sep"):
+        if sequence_parallel:
+            from ...distributed.collective import axis_in_trace
+            if axis_in_trace("sep"):
+                if m is not None or dropout_p > 0.0 or q.ndim != 4 \
+                        or q.shape[1] != k.shape[1]:
+                    raise NotImplementedError(
+                        "scaled_dot_product_attention under the 'sep' "
+                        "sequence-parallel axis supports only maskless, "
+                        "dropout-free self-attention (the ring schedule); "
+                        "disable attention dropout / masks under sequence "
+                        "parallelism, or pass sequence_parallel=False if "
+                        "the sequence was already gathered")
                 from ...distributed.ring_attention import ring_attention
                 out = ring_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),

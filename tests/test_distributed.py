@@ -459,3 +459,55 @@ def test_sdpa_routes_to_ring_attention_under_sep():
         out_specs=P(None, "sep")))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_sdpa_under_sep_raises_on_unsupported_configs():
+    """Under a bound 'sep' axis, configs the ring schedule cannot express
+    must raise — silent shard-local attention would be mathematically
+    wrong; sequence_parallel=False opts gathered-sequence code out."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    if len(jax.devices()) < 4:
+        import pytest as _pytest
+        _pytest.skip("needs 4 devices")
+    b, s, h, d = 2, 32, 2, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+
+    def dropout_attn(q_):
+        out = F.scaled_dot_product_attention(
+            paddle.Tensor(q_), paddle.Tensor(q_), paddle.Tensor(q_),
+            dropout_p=0.1, is_causal=True, training=True)
+        return out._array
+
+    with pytest.raises(NotImplementedError, match="sequence-parallel"):
+        jax.jit(shard_map(dropout_attn, mesh=mesh,
+                          in_specs=P(None, "sep"),
+                          out_specs=P(None, "sep")))(q)
+
+    # opt-out: a gathered full sequence computes plain attention per device
+    def gathered_attn(q_):
+        full = jax.lax.all_gather(q_, "sep", axis=1, tiled=True)
+        out = F.scaled_dot_product_attention(
+            paddle.Tensor(full), paddle.Tensor(full), paddle.Tensor(full),
+            is_causal=True, training=False, sequence_parallel=False)
+        arr = out._array
+        # return this device's shard of the result
+        i = jax.lax.axis_index("sep")
+        return jax.lax.dynamic_slice_in_dim(
+            arr, i * q_.shape[1], q_.shape[1], axis=1)
+
+    got = jax.jit(shard_map(gathered_attn, mesh=mesh,
+                            in_specs=P(None, "sep"),
+                            out_specs=P(None, "sep")))(q)
+    want = F.scaled_dot_product_attention(
+        paddle.Tensor(q), paddle.Tensor(q), paddle.Tensor(q),
+        is_causal=True, training=False).numpy()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
