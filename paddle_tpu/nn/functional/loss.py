@@ -13,6 +13,28 @@ import jax.numpy as jnp
 from ...core.dispatch import call, wrap_op
 
 
+def _pallas_ce_gate(flag_name, logits):
+    """Shared eligibility gate for the Pallas CE/LSE routes: flag on, TPU
+    backend, SINGLE device (a Mosaic custom call has no GSPMD partitioning
+    rule — under a multi-device pjit XLA would all-gather the (N, V)
+    logits per device; the sharded-model CE is ParallelCrossEntropy and
+    the 'sep' routing, not this).  Returns (n, v, lead) or None."""
+    from ...utils.flags import fast_get
+    if not fast_get(flag_name):
+        return None
+    try:
+        if jax.default_backend() != "tpu" or len(jax.devices()) != 1:
+            return None
+    except Exception:
+        return None
+    v = logits.shape[-1]
+    lead = logits.shape[:-1]
+    n = 1
+    for dim in lead:
+        n *= dim
+    return n, v, lead
+
+
 def _fused_ce_or_none(logits, lbl, ignore_index):
     """Opt-in route (FLAGS_use_pallas_ce=1) to the Pallas fused softmax-CE
     kernel.  Default stays XLA: the streaming-reduction path measured
@@ -20,21 +42,11 @@ def _fused_ce_or_none(logits, lbl, ignore_index):
     caps the kernel at 8-row tiles whose grid overhead outweighs the fused
     gather.  The kernel remains the escape hatch for shapes where XLA's
     reduction fusion misbehaves.  Returns None to take the XLA path."""
-    from ...utils.flags import fast_get
-    if not fast_get("use_pallas_ce"):
+    gate = _pallas_ce_gate("use_pallas_ce", logits)
+    if gate is None:
         return None
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        return None
-    if backend != "tpu":
-        return None
+    n, v, lead = gate
     from ...kernels import ce_pallas
-    v = logits.shape[-1]
-    lead = logits.shape[:-1]
-    n = 1
-    for dim in lead:
-        n *= dim
     if not ce_pallas.supported(n, v):
         return None
     # index math under x64-off: s64 labels would otherwise put emulated
@@ -45,6 +57,26 @@ def _fused_ce_or_none(logits, lbl, ignore_index):
     nll = nll.reshape(lead)
     mask = (lbl != ignore_index)
     return jnp.where(mask, nll, 0.0)
+
+
+def _streamed_lse_or_none(logits, axis):
+    """One-pass streamed Pallas logsumexp over the class axis
+    (FLAGS_use_pallas_lse): ONE read of the bf16 logits with online
+    (max, sum-exp2) statistics vs XLA's two streaming reductions.
+    Returns None to take the XLA path (non-TPU, multi-device, unsupported
+    shape/dtype, or the class axis is not last)."""
+    if axis not in (-1, logits.ndim - 1):
+        return None
+    if logits.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        return None
+    gate = _pallas_ce_gate("use_pallas_lse", logits)
+    if gate is None:
+        return None
+    n, v, lead = gate
+    from ...kernels import ce_pallas
+    if not ce_pallas.lse_supported(n, v, logits.dtype.itemsize):
+        return None
+    return ce_pallas.logsumexp_pallas(logits.reshape(n, v)).reshape(lead)
 
 
 def _reduce(out, reduction, weight_sum=None):
@@ -75,19 +107,22 @@ def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
         out = _fused_ce_or_none(logits, lbl, ignore_index)
         if out is not None:
             return out
-    # keep every elementwise use of `logits` in its own consumer fusion:
-    # binding `lf = logits.astype(f32)` once made XLA CSE the convert and
-    # MATERIALISE the full f32 logits (1.65 GB at GPT-2 bench shapes,
-    # ~10 ms/step of HBM traffic); with per-consumer converts the bf16
-    # matmul output is the only materialised array and each streaming
-    # reduction fuses its own upcast
-    # (a max-free clamped variant was benched and measured no faster —
-    # XLA's two streaming reductions are not the bottleneck they look like)
-    m = jax.lax.stop_gradient(jnp.max(logits, axis=axis))
-    mf = m.astype(jnp.float32)
-    lse = mf + jnp.log(jnp.sum(
-        jnp.exp(logits.astype(jnp.float32) - jnp.expand_dims(mf, axis)),
-        axis=axis))
+    lse = _streamed_lse_or_none(logits, axis)
+    if lse is None:
+        # keep every elementwise use of `logits` in its own consumer fusion:
+        # binding `lf = logits.astype(f32)` once made XLA CSE the convert and
+        # MATERIALISE the full f32 logits (1.65 GB at GPT-2 bench shapes,
+        # ~10 ms/step of HBM traffic); with per-consumer converts the bf16
+        # matmul output is the only materialised array and each streaming
+        # reduction fuses its own upcast
+        # (a max-free clamped variant was benched and measured no faster —
+        # XLA's two streaming reductions are not the bottleneck they look
+        # like)
+        m = jax.lax.stop_gradient(jnp.max(logits, axis=axis))
+        mf = m.astype(jnp.float32)
+        lse = mf + jnp.log(jnp.sum(
+            jnp.exp(logits.astype(jnp.float32) - jnp.expand_dims(mf, axis)),
+            axis=axis))
     # gather under x64-off: take_along_axis promotes its index math to
     # s64 in x64 mode, putting emulated 64-bit ops into the TPU program
     # (caught by tests/test_x64_audit.py)

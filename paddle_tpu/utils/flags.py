@@ -66,6 +66,11 @@ define_flag("use_pallas_ce", False,
             "route hard-label cross_entropy through the fused Pallas "
             "softmax-CE kernel (XLA's streaming path measured faster on "
             "the 345M bench; opt-in escape hatch)")
+define_flag("use_pallas_lse", True,
+            "compute hard-label CE's logsumexp with the one-pass streamed "
+            "Pallas kernel (big tiles, online max/sum-exp2) instead of "
+            "XLA's two streaming reductions — measured +~5%% tokens/s on "
+            "the GPT-2 345M bench (PERF.md round-4)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")

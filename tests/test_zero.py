@@ -77,11 +77,13 @@ def test_zero_stage_parity_and_shardings(sdp_mesh, stage):
         losses.append(float(step(x, y).numpy()))
     np.testing.assert_allclose(losses, losses_ref, rtol=2e-4, atol=1e-5)
 
-    # params after training match too
+    # params after training match too; compare through the per-name
+    # external contract so the test is layout-agnostic
+    ref_params = ref_step.state_dict()["params"]
     for k in step.params:
         np.testing.assert_allclose(
             np.asarray(step.params[k]).astype(np.float32),
-            np.asarray(ref_step.params[k]).astype(np.float32),
+            np.asarray(ref_params[k]).astype(np.float32),
             atol=1e-4, rtol=1e-3, err_msg=k)
 
 
@@ -122,7 +124,10 @@ def test_zero_stage2_grads_reduce_scattered(sdp_mesh):
     ref = _build()
     ref_opt = paddle.optimizer.AdamW(parameters=ref.parameters(),
                                      learning_rate=0.01)
-    ref_step = TrainStep(ref, _loss, ref_opt, donate=False)
+    # explicit flat_master=False: _grads_core must expose per-name grads
+    # regardless of any future default-layout change
+    ref_step = TrainStep(ref, _loss, ref_opt, donate=False,
+                         flat_master=False)
     _, _, ref_grads = jax.jit(ref_step._grads_core)(
         ref_step.params, ref_step.buffers, jax.random.key(0),
         (x._array, y._array))
