@@ -170,6 +170,15 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._array.shape[0]
 
+    def __iter__(self):
+        # explicit __iter__ is REQUIRED: without it Python falls back to
+        # the __getitem__ protocol with ever-growing indices, and jax's
+        # clamping gather never raises IndexError -> infinite loop on any
+        # eager `for row in tensor` (reference tensors iterate rows)
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self._array.shape[0]))
+
     def __bool__(self):
         return bool(self._array)
 

@@ -11,6 +11,16 @@ keep XLA happy — and the global exchange is ONE lax.all_to_all over the 'ep'
 mesh axis (replacing the reference's global_scatter/global_gather CUDA+NCCL
 pair).  Works identically outside shard_map (single device = all experts
 local, all_to_all skipped).
+
+Hybrid composition: ``moe_apply`` is the SPMD functional form — ep x dp in
+ONE program (expert bank sharded P('ep'), tokens P('dp'), per-dp-rank
+dispatch like the reference's fleet-hybrid MoE; driven in
+__graft_entry__.py §3b and tests/test_distributed.py).  ep-UNDER-pp is NOT
+wired: the compiled 1F1B schedule requires structurally identical blocks
+per stage, and an MoE block's all_to_all would run inside the per-tick
+lax.cond where collective ordering across stages is unverified — compose
+MoE with dp/mp today and keep 'ep' orthogonal to 'pp' (raise/guard lives
+in the pipeline's structural-identity check).
 """
 from __future__ import annotations
 
@@ -111,6 +121,84 @@ def top2_routing(logits, capacity, num_experts):
     return dispatch, combine, aux
 
 
+def _route(logits, capacity, num_experts, top_k):
+    if top_k == 1:
+        return top1_routing(logits, capacity, num_experts)
+    return top2_routing(logits, capacity, num_experts)
+
+
+def moe_dispatch(tok, logits, *, top_k, capacity, num_experts, nle, axis):
+    """Token -> expert-slot dispatch (pure; shard_map-aware).  Returns
+    (expert_in (nle, slots, d), aux).  Under a bound `axis`, ONE
+    lax.all_to_all exchanges the (ep, nle, C, d) slots so each device
+    holds every source shard's slots for ITS local experts."""
+    dispatch, _, aux = _route(logits, capacity, num_experts, top_k)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(tok.dtype), tok)
+    if _in_trace(axis):
+        ep = num_experts // nle
+        expert_in = expert_in.reshape(ep, nle, capacity, -1)
+        expert_in = jax.lax.all_to_all(expert_in, axis, split_axis=0,
+                                       concat_axis=0, tiled=False)
+        expert_in = jnp.swapaxes(expert_in, 0, 1)      # (nle, ep, C, d)
+        expert_in = expert_in.reshape(nle, ep * capacity, -1)
+    else:
+        expert_in = expert_in.reshape(nle, (num_experts // nle) * capacity,
+                                      -1)
+    return expert_in, aux
+
+
+def moe_combine(eo, logits, *, top_k, capacity, num_experts, nle, axis,
+                dtype=None):
+    """Expert outputs -> tokens (inverse all_to_all + weighted combine)."""
+    _, combine, _ = _route(logits, capacity, num_experts, top_k)
+    if _in_trace(axis):
+        ep = num_experts // nle
+        eo = eo.reshape(nle, ep, capacity, -1)
+        eo = jnp.swapaxes(eo, 0, 1)                    # (ep, nle, C, d)
+        eo = jax.lax.all_to_all(eo, axis, split_axis=0, concat_axis=0,
+                                tiled=False)
+    eo = eo.reshape(num_experts, capacity, -1)
+    return jnp.einsum("tec,ecd->td", combine.astype(eo.dtype), eo)
+
+
+def moe_apply(params, x, *, top_k=1, capacity_factor=2.0, axis=EP_AXIS,
+              num_experts=None, act="gelu"):
+    """Pure functional MoE block for SPMD driving (ep x dp in ONE program
+    — reference moe_layer.py:226 under the fleet hybrid topology).
+
+    params (PER-SHARD leaves inside shard_map):
+        gate: (d, E)          — replicated
+        w1:   (nle, d, h)     — the shard of the (E, d, h) expert bank
+        b1:   (nle, h)          sharded P(axis) on dim 0
+        w2:   (nle, h, d)
+        b2:   (nle, d)
+    x: (b_local, s, d) — this data-parallel rank's tokens (each dp rank
+    routes its own tokens with its own capacity, the reference's per-rank
+    dispatch semantics).  Returns (out (b_local, s, d), aux_loss)."""
+    b, s, d = x.shape
+    tok = x.reshape(b * s, d)
+    logits = tok @ params["gate"]
+    nle = params["w1"].shape[0]
+    if num_experts is None:
+        ep = jax.lax.psum(1, axis) if _in_trace(axis) else 1
+        num_experts = nle * ep
+    t = b * s
+    capacity = max(int(math.ceil(top_k * capacity_factor * t
+                                 / num_experts)), 4)
+    expert_in, aux = moe_dispatch(tok, logits, top_k=top_k,
+                                  capacity=capacity,
+                                  num_experts=num_experts, nle=nle,
+                                  axis=axis)
+    h = jnp.einsum("ncd,ndh->nch", expert_in, params["w1"]) \
+        + params["b1"][:, None, :]
+    h = getattr(jax.nn, act)(h)
+    eo = jnp.einsum("nch,nhd->ncd", h, params["w2"]) \
+        + params["b2"][:, None, :]
+    out = moe_combine(eo, logits, top_k=top_k, capacity=capacity,
+                      num_experts=num_experts, nle=nle, axis=axis)
+    return out.reshape(b, s, d), aux
+
+
 class NaiveGate(Layer):
     def __init__(self, d_model, num_experts, topk=2):
         super().__init__()
@@ -179,25 +267,8 @@ class MoELayer(Layer):
         nle = self.num_local_experts
 
         def raw(tok, lg, *unused):
-            if top_k == 1:
-                dispatch, combine, aux = top1_routing(lg, capacity, num_experts)
-            else:
-                dispatch, combine, aux = top2_routing(lg, capacity, num_experts)
-            # (T, E, C) x (T, d) -> (E, C, d)
-            expert_in = jnp.einsum("tec,td->ecd",
-                                   dispatch.astype(tok.dtype), tok)
-            in_trace = _in_trace(axis)
-            if in_trace:
-                # (E, C, d) = (ep*nle, C, d): exchange so each device holds
-                # the C-slots of ITS local experts from every source device
-                ep = num_experts // nle
-                expert_in = expert_in.reshape(ep, nle, capacity, -1)
-                expert_in = jax.lax.all_to_all(expert_in, axis, split_axis=0,
-                                               concat_axis=0, tiled=False)
-                # now (ep, nle, C, d) where leading dim = source shard
-                expert_in = jnp.swapaxes(expert_in, 0, 1)  # (nle, ep, C, d)
-                expert_in = expert_in.reshape(nle, ep * capacity, -1)
-            return expert_in, aux
+            return moe_dispatch(tok, lg, top_k=top_k, capacity=capacity,
+                                num_experts=num_experts, nle=nle, axis=axis)
 
         expert_in, aux = call(raw, tokens, logits, name="moe_dispatch")
         self.aux_loss = aux * self.aux_loss_weight
@@ -212,20 +283,8 @@ class MoELayer(Layer):
         expert_out = ops.stack(outs, axis=0)          # (nle, slots, d)
 
         def raw_combine(eo, tok, lg):
-            if top_k == 1:
-                dispatch, combine, _ = top1_routing(lg, capacity, num_experts)
-            else:
-                dispatch, combine, _ = top2_routing(lg, capacity, num_experts)
-            if _in_trace(axis):
-                ep = num_experts // nle
-                eo = eo.reshape(nle, ep, capacity, -1)
-                eo = jnp.swapaxes(eo, 0, 1)            # (ep, nle, C, d)
-                eo = jax.lax.all_to_all(eo, axis, split_axis=0,
-                                        concat_axis=0, tiled=False)
-                eo = eo.reshape(num_experts, capacity, -1)
-            else:
-                eo = eo.reshape(num_experts, capacity, -1)
-            return jnp.einsum("tec,ecd->td", combine.astype(eo.dtype), eo)
+            return moe_combine(eo, lg, top_k=top_k, capacity=capacity,
+                               num_experts=num_experts, nle=nle, axis=axis)
 
         out = call(raw_combine, expert_out, tokens, logits,
                    name="moe_combine")

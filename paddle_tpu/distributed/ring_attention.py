@@ -4,19 +4,26 @@ The reference has NO sequence parallelism (SURVEY.md §5.7: no ring
 attention/Ulysses/blockwise anywhere in the snapshot); this is a required
 capability of the TPU build.  Design:
 
-* ring_attention: each device holds a sequence shard of Q and of K/V.
-  K/V shards rotate around the ring via lax.ppermute (ICI neighbor
-  exchange) while each device accumulates blockwise-softmax statistics for
-  its Q shard — O(S_local) memory, compute overlapped with the rotation by
-  XLA's async collectives.  Causality is enforced from global block
-  positions (axis_index).
+* ring_attention: each device holds a contiguous sequence shard of Q and of
+  K/V.  K/V shards rotate around the ring via lax.ppermute (ICI neighbor
+  exchange) in their ORIGINAL dtype (bf16 shards move 2 B/elem; an earlier
+  revision rotated f32 and doubled the wire bytes) while each device
+  accumulates blockwise-softmax statistics for its Q shard.  The inner
+  block is itself BLOCKWISE: a remat'd scan over key chunks with online
+  (max, sum, acc) statistics, so per-device memory is O(s_loc * chunk) in
+  forward AND backward — never the (s_loc, s_loc) logits block.  Causality
+  is enforced from global block positions (axis_index): ring steps holding
+  strictly-future shards are skipped entirely (lax.cond — no MXU/VPU
+  work), strictly-past shards run mask-free, and only the self shard pays
+  the elementwise causal mask.
 * ulysses_attention: the all-to-all variant — resharding (seq-sharded ->
   head-sharded) with two lax.all_to_all calls around ordinary local
   attention; composes with TP by splitting the head dim.
 
 Both are plain jax functions intended for use inside shard_map (see
 tests/test_distributed.py for the driving pattern); grads flow through
-scan+ppermute natively.
+scan+ppermute+cond natively, with jax.checkpoint on the chunk body keeping
+the backward blockwise too.
 """
 from __future__ import annotations
 
@@ -27,80 +34,175 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+#: key-chunk width of the blockwise inner loop (elements of the rotating
+#: K/V shard processed per online-softmax update)
+DEFAULT_CHUNK = 512
 
-def _local_attn_block(q, k, v, scale, mask):
-    """One (Sq_local x Sk_block) attention block in f32 stats.
 
-    q: (B, H, Sq, D); k/v: (B, H, Sk, D); mask: (Sq, Sk) bool or None.
-    Returns (m, l, acc): running max (B,H,Sq), denom (B,H,Sq),
-    weighted values (B,H,Sq,D).
+def _chunk_for(s_blk: int, chunk: int) -> int:
+    """Largest divisor of the K/V block length not exceeding ``chunk``."""
+    c = min(chunk, s_blk)
+    while s_blk % c:
+        c -= 1
+    return c
+
+
+def _pvary(a, axis_name):
+    """newer jax: scan carries inside shard_map are vma-typed; constants
+    must be promoted to device-varying before entering the carry."""
+    if axis_name is None:
+        return a
+    try:
+        return jax.lax.pvary(a, axis_name)
+    except (AttributeError, ValueError):
+        return a
+
+
+def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
+                    chunk, axis_name=None):
+    """Blockwise (chunked, online-softmax) attention of the local Q shard
+    against ONE rotating K/V shard.
+
+    q: (B, H, Sq, D); k_blk/v_blk: (B, H, Sk, D) in their original dtype
+    (bf16 contractions hit the MXU natively via preferred_element_type).
+    q_off/k_off: traced global offsets of the shards (for the causal mask
+    when ``diag``, a STATIC bool — the caller picks the masked or unmasked
+    trace via lax.cond).  mask_blk: optional ADDITIVE f32 mask
+    broadcastable to (B, H, Sq, Sk).  Returns (out, lse): out
+    (B, H, Sq, D) f32 normalized within the block, lse (B, H, Sq) f32
+    base-e.
+
+    Memory: O(Sq * chunk) — the chunk body is jax.checkpoint'd so scan's
+    backward recomputes the chunk logits instead of saving them.
     """
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    if mask is not None:
-        logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    m = jnp.max(logits, axis=-1)
-    p = jnp.exp(logits - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return m, l, acc
+    b, h, sq, d = q.shape
+    sk = k_blk.shape[2]
+    c = _chunk_for(sk, chunk)
+    nck = sk // c
+
+    def body(carry, ci):
+        m, l, acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(k_blk, ci * c, c, 2)
+        vs = jax.lax.dynamic_slice_in_dim(v_blk, ci * c, c, 2)
+        logits = jax.lax.dot_general(
+            q, ks, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale    # (B, H, Sq, c)
+        if mask_blk is not None:
+            mb = jax.lax.dynamic_slice_in_dim(
+                mask_blk, ci * c, c, mask_blk.ndim - 1)
+            logits = logits + mb.astype(jnp.float32)
+        if diag:
+            # elementwise causality on global positions — only the SELF
+            # shard takes this branch (strictly-past shards run the
+            # mask-free trace; strictly-future ones are skipped upstream)
+            q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, c), 0)
+            k_pos = k_off + ci * c + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, c), 1)
+            logits = jnp.where((k_pos <= q_pos)[None, None], logits,
+                               jnp.float32(_NEG_INF))
+        new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_acc = acc * corr[..., None] + jax.lax.dot_general(
+            p.astype(vs.dtype), vs, (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        return (new_m, new_l, new_acc), None
+
+    init = (_pvary(jnp.full((b, h, sq), _NEG_INF, jnp.float32), axis_name),
+            _pvary(jnp.zeros((b, h, sq), jnp.float32), axis_name),
+            _pvary(jnp.zeros((b, h, sq, d), jnp.float32), axis_name))
+    (m, l, acc), _ = jax.lax.scan(jax.checkpoint(body), init,
+                                  jnp.arange(nck, dtype=jnp.int32))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe[..., None]
+    lse = m + jnp.log(l_safe)
+    return out, lse
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   scale=None):
+                   scale=None, attn_mask=None, chunk: int = DEFAULT_CHUNK):
     """Blockwise ring attention under shard_map.
 
-    q, k, v: (B, H, S_local, D) — the local sequence shard.
-    Returns (B, H, S_local, D).
+    q, k, v: (B, H, S_local, D) — the local CONTIGUOUS sequence shard
+    (equal length on every rank; global position of rank r's tokens is
+    [r*S_local, (r+1)*S_local)).
+    attn_mask: optional ADDITIVE mask, broadcastable to
+    (B, H, S_local, S_global) — the caller's local q rows against the FULL
+    key axis; each ring step slices the columns of the shard it holds.
+    Returns (B, H, S_local, D) in q's dtype.
     """
     n = jax.lax.axis_size(axis_name) if hasattr(jax.lax, "axis_size") else \
         jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
+    if k.shape[1] != h:
+        raise NotImplementedError(
+            "ring_attention: grouped-query/multi-query attention (k heads "
+            "%d != q heads %d) is not supported under the 'sep' ring — "
+            "repeat K/V heads before sharding or gather the sequence"
+            % (k.shape[1], h))
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     scale = jnp.float32(scale)
+    if attn_mask is not None and attn_mask.shape[-2] != s_loc:
+        raise ValueError(
+            "ring_attention: attn_mask rows (%d) must cover the LOCAL q "
+            "shard (%d); its columns cover the global key axis"
+            % (attn_mask.shape[-2], s_loc))
 
-    q32 = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    my = jnp.asarray(my, jnp.int32)       # x64 mode: keep index math i32
+    n32 = jnp.int32(n)
+    q_off = my * jnp.int32(s_loc)
 
     def step(carry, i):
-        m, l, acc, kv = carry
-        k_blk, v_blk = kv
-        src = (my - i) % n   # which shard's K/V we currently hold
+        out_acc, lse_acc, k_cur, v_cur = carry
+        src = jax.lax.rem(my - i + n32, n32)   # whose shard we hold
+        k_off = src * jnp.int32(s_loc)
+
+        def attend_with(diag):
+            def fn(operand):
+                k_b, v_b = operand
+                mask_blk = None
+                if attn_mask is not None:
+                    mask_blk = jax.lax.dynamic_slice_in_dim(
+                        attn_mask, k_off, s_loc, attn_mask.ndim - 1)
+                out_b, lse_b = _blockwise_attn(
+                    q, k_b, v_b, scale, q_off, k_off, diag, mask_blk,
+                    chunk, axis_name)
+                # flash-style two-level combine of normalized block results
+                new_lse = jnp.logaddexp(lse_acc, lse_b)
+                a = jnp.exp(lse_acc - new_lse)
+                bb = jnp.exp(lse_b - new_lse)
+                return (out_acc * a[..., None] + out_b * bb[..., None],
+                        new_lse)
+            return fn
+
+        def skip(operand):
+            return out_acc, lse_acc
+
         if causal:
-            # block-level causality on global positions
-            q_pos = my * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 0)
-            k_pos = src * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1)
-            mask = k_pos <= q_pos
+            # strictly-future shards contribute nothing (no matmuls at
+            # all); only the SELF shard pays the elementwise causal mask
+            out_new, lse_new = jax.lax.cond(
+                src > my, skip,
+                lambda op: jax.lax.cond(src == my, attend_with(True),
+                                        attend_with(False), op),
+                (k_cur, v_cur))
         else:
-            mask = None
-        bm, bl, bacc = _local_attn_block(q32, k_blk, v_blk, scale, mask)
-        new_m = jnp.maximum(m, bm)
-        corr = jnp.exp(m - new_m)
-        bcorr = jnp.exp(bm - new_m)
-        new_l = l * corr + bl * bcorr
-        new_acc = acc * corr[..., None] + bacc * bcorr[..., None]
-        # rotate K/V to the next device (skipped result unused on last step)
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (new_m, new_l, new_acc, (k_next, v_next)), None
+            out_new, lse_new = attend_with(False)((k_cur, v_cur))
+        # rotate K/V to the next device IN THEIR ORIGINAL DTYPE (bf16
+        # shards move half the bytes of the old f32 rotation)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (out_new, lse_new, k_next, v_next), None
 
-    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    def _vary(a):  # newer jax: carry constants must be device-varying
-        try:
-            return jax.lax.pvary(a, axis_name)
-        except (AttributeError, ValueError):
-            return a
+    out0 = _pvary(jnp.zeros((b, h, s_loc, d), jnp.float32), axis_name)
+    lse0 = _pvary(jnp.full((b, h, s_loc), _NEG_INF, jnp.float32), axis_name)
 
-    m0, l0, acc0 = _vary(m0), _vary(l0), _vary(acc0)
-    (m, l, acc, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, (k.astype(jnp.float32), v.astype(jnp.float32))),
-        jnp.arange(n))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    (out, _lse, _k, _v), _ = jax.lax.scan(
+        step, (out0, lse0, k, v), jnp.arange(n, dtype=jnp.int32))
     return out.astype(q.dtype)
 
 
@@ -137,13 +239,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
         def attn_fn(q_, k_, v_):
             d = q_.shape[-1]
             s = scale if scale is not None else 1.0 / (d ** 0.5)
-            logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_).astype(jnp.float32) * s
-            if causal:
-                sq = logits.shape[-2]
-                mask = jnp.tril(jnp.ones((sq, sq), bool))
-                logits = jnp.where(mask[None, None], logits, _NEG_INF)
-            p = jax.nn.softmax(logits, axis=-1)
-            return jnp.einsum("bhqk,bhkd->bhqd", p,
-                              v_.astype(jnp.float32)).astype(q_.dtype)
+            # blockwise inner here too: the gathered S_full axis is the
+            # long one — never materialise (S_full, S_full) logits
+            out, _ = _blockwise_attn(
+                q_, k_, v_, jnp.float32(s), jnp.int32(0), jnp.int32(0),
+                causal, None, DEFAULT_CHUNK, axis_name)
+            return out.astype(q_.dtype)
     out = attn_fn(q2, k2, v2)
     return head_to_seq(out)

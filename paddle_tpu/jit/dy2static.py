@@ -24,6 +24,13 @@ data-INdependent control flow needs no rewrite under jax tracing anyway):
 * ``while`` whose body assigns previously-bound names: loop-carried
   variables are every name assigned in the body that is bound before the
   loop; ``break``/``continue``/``return`` inside are not supported.
+* ``for i in range(...)`` — lax.fori_loop over a computed trip count when
+  any bound is a tensor (step must be concrete); ``for x in tensor`` —
+  lax.scan over the leading axis; ``for x in <python iterable>`` keeps
+  plain-Python unrolling.  Same carried-variable rules as ``while``;
+  ``break``/``continue``/``return`` and tuple targets raise.
+  (reference: loop_transformer.py:1, convert_operators.py convert_len /
+  convert_while_loop)
 """
 from __future__ import annotations
 
@@ -37,8 +44,9 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["convert_ifelse", "convert_while", "convert_bool",
-           "transform_function", "Dy2StaticUnsupportedError"]
+__all__ = ["convert_ifelse", "convert_while", "convert_range_for",
+           "convert_iter_for", "convert_bool", "transform_function",
+           "Dy2StaticUnsupportedError"]
 
 
 class Dy2StaticUnsupportedError(Exception):
@@ -172,6 +180,122 @@ def convert_while(cond_fn: Callable, body_fn: Callable, args: tuple):
     return vals
 
 
+def convert_range_for(rng_args: tuple, body_fn: Callable, args: tuple,
+                      prior=UNDEFINED):
+    """``for i in range(...)`` (reference: loop_transformer.py +
+    convert_operators.py convert_len semantics).  A tensor-dependent bound
+    lowers to lax.fori_loop over a computed trip count; concrete bounds run
+    the plain Python loop.  body_fn(i, *carried) -> carried.
+
+    Returns ``(final_target,) + carried`` — Python leaves the loop
+    variable bound to its last value after the loop, so the rewrite
+    rebinds it (``prior`` = the pre-loop binding, used when the traced
+    trip count is 0; with no prior binding the would-be first index is
+    the fallback, where Python would have raised NameError)."""
+    from ..core.tensor import Tensor
+
+    vals = tuple(rng_args)
+    if len(vals) == 1:
+        start, stop, step = 0, vals[0], 1
+    elif len(vals) == 2:
+        start, stop, step = vals[0], vals[1], 1
+    else:
+        start, stop, step = vals
+    traced = any(map(_is_traced, _unwrap_all((start, stop, step)))) or \
+        any(map(_is_traced, _unwrap_all(args)))
+    if not traced:
+        out = args
+        cur = prior
+        for i in range(int(_as_array(start)) if _is_tensorish(start)
+                       else start,
+                       int(_as_array(stop)) if _is_tensorish(stop)
+                       else stop,
+                       int(_as_array(step)) if _is_tensorish(step)
+                       else step):
+            cur = i
+            out = body_fn(i, *out)
+        return (cur,) + tuple(out)
+    if _is_traced(_as_array(step)):
+        raise Dy2StaticUnsupportedError(
+            "a converted `for i in range(...)` needs a CONCRETE step (the "
+            "trip-count sign must be known at trace time); only start/stop "
+            "may be tensors")
+    if any(v is UNDEFINED for v in args):
+        raise Dy2StaticUnsupportedError(
+            "a variable assigned inside a converted for loop must be bound "
+            "before the loop (lax loop carries need a defined initial "
+            "value)")
+    step_i = int(_as_array(step)) if _is_tensorish(step) else int(step)
+    if step_i == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    start_a = jnp.asarray(_as_array(start), jnp.int32).reshape(())
+    stop_a = jnp.asarray(_as_array(stop), jnp.int32).reshape(())
+    if step_i > 0:
+        n = jnp.maximum(0, (stop_a - start_a + step_i - 1) // step_i)
+    else:
+        n = jnp.maximum(0, (start_a - stop_a + (-step_i) - 1) // (-step_i))
+
+    arrs = _unwrap_all(args)
+
+    def body(idx, carry):
+        i = start_a + jnp.asarray(idx, jnp.int32) * step_i
+        out = body_fn(Tensor(i), *_rewrap(carry, args))
+        out = _unwrap_all(out)
+        # keep carry dtypes stable for fori_loop typing
+        return tuple(
+            jnp.asarray(o).astype(jnp.asarray(a).dtype)
+            if hasattr(a, "dtype") and hasattr(o, "dtype") else o
+            for o, a in zip(out, carry))
+
+    out = jax.lax.fori_loop(jnp.int32(0), n.astype(jnp.int32), body, arrs)
+    last = start_a + jnp.maximum(n - 1, 0).astype(jnp.int32) * step_i
+    if prior is not UNDEFINED and _is_tensorish(prior):
+        fallback = jnp.asarray(_as_array(prior)).astype(jnp.int32).reshape(())
+    elif prior is not UNDEFINED and isinstance(prior, int):
+        fallback = jnp.int32(prior)
+    else:
+        fallback = start_a
+    final = Tensor(jnp.where(n > 0, last, fallback))
+    return (final,) + tuple(Tensor(o) if hasattr(o, "dtype") else o
+                            for o in out)
+
+
+def convert_iter_for(xs, body_fn: Callable, args: tuple, prior=UNDEFINED):
+    """``for x in <iterable>``: a tensor iterable scans its leading axis
+    (lax.scan — the static-shape rendering of the reference's while-based
+    tensor iteration); any other iterable runs the plain Python loop
+    (which simply unrolls under jax tracing).  Like
+    :func:`convert_range_for`, returns ``(final_target,) + carried``."""
+    from ..core.tensor import Tensor
+
+    if _is_tensorish(xs):
+        if any(v is UNDEFINED for v in args):
+            raise Dy2StaticUnsupportedError(
+                "a variable assigned inside a converted for loop must be "
+                "bound before the loop (lax loop carries need a defined "
+                "initial value)")
+        xs_a = _as_array(xs)
+
+        def body(carry, x_t):
+            out = body_fn(Tensor(x_t), *_rewrap(carry, args))
+            out = _unwrap_all(out)
+            out = tuple(
+                jnp.asarray(o).astype(jnp.asarray(a).dtype)
+                if hasattr(a, "dtype") and hasattr(o, "dtype") else o
+                for o, a in zip(out, carry))
+            return out, None
+        carry, _ = jax.lax.scan(body, _unwrap_all(args), xs_a)
+        final = Tensor(xs_a[-1]) if xs_a.shape[0] > 0 else prior
+        return (final,) + tuple(Tensor(o) if hasattr(o, "dtype") else o
+                                for o in carry)
+    out = args
+    cur = prior
+    for x in xs:
+        cur = x
+        out = body_fn(x, *out)
+    return (cur,) + tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # AST transformer (reference: ifelse_transformer.py / loop_transformer.py)
 # ---------------------------------------------------------------------------
@@ -200,15 +324,18 @@ def _ends_in_return(stmts) -> bool:
     return bool(stmts) and isinstance(stmts[-1], ast.Return)
 
 
-def _make_branch_fn(name, argnames, body, extra_return):
-    """def <name>(a, b, ...): <body>; return (a, b, ...)"""
+def _make_branch_fn(name, argnames, body, extra_return, return_names=None):
+    """def <name>(a, b, ...): <body>; return (a, b, ...).
+    ``return_names`` overrides the returned tuple (loop bodies take the
+    iteration variable as their first arg but carry only the rest)."""
     args = ast.arguments(
         posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
         vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
     stmts = list(body)
     if extra_return:
+        rets = argnames if return_names is None else return_names
         stmts.append(ast.Return(value=ast.Tuple(
-            elts=[ast.Name(id=a, ctx=ast.Load()) for a in argnames],
+            elts=[ast.Name(id=a, ctx=ast.Load()) for a in rets],
             ctx=ast.Load())))
     return ast.FunctionDef(name=name, args=args, body=stmts,
                            decorator_list=[], returns=None, type_params=[])
@@ -316,14 +443,72 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [cfn, bfn, ast.Assign(targets=[target], value=call)]
 
 
+    # -- for ---------------------------------------------------------------
+    def visit_For(self, node: ast.For):
+        """reference: loop_transformer.py — ``for i in range(...)`` lowers
+        via convert_range_for (lax.fori_loop), ``for x in tensor`` via
+        convert_iter_for (lax.scan); break/continue/return raise loudly."""
+        self.generic_visit(node)
+        if node.orelse:
+            raise Dy2StaticUnsupportedError("for/else is not supported")
+        if _has_stmt(node.body, (ast.Break, ast.Continue, ast.Return)):
+            raise Dy2StaticUnsupportedError(
+                "break/continue/return inside a converted for loop; "
+                "restructure as a while with an explicit flag or use "
+                "static.nn.while_loop directly")
+        if not isinstance(node.target, ast.Name):
+            raise Dy2StaticUnsupportedError(
+                "only `for <name> in ...` is convertible (tuple unpacking "
+                "targets are not)")
+        tgt = node.target.id
+        carried = sorted(_store_names(node.body) - {tgt})
+        if not carried:
+            raise Dy2StaticUnsupportedError(
+                "for body assigns no variables — effect-only loops are "
+                "not convertible")
+        bname = self._next("forbody")
+        bfn = _make_branch_fn(bname, [tgt] + carried, node.body,
+                              extra_return=True, return_names=carried)
+        # the pre-loop binding of the target (UNDEFINED if none): the
+        # converters return (final_target,) + carried so the loop variable
+        # stays bound to its last value after the loop, as in Python
+        prior = _call_rt(
+            "_local_default",
+            ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                     args=[], keywords=[]),
+            ast.Constant(tgt))
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords)
+        if is_range:
+            call = _call_rt(
+                "convert_range_for",
+                ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()), _args_tuple(carried),
+                prior)
+        else:
+            call = _call_rt(
+                "convert_iter_for", node.iter,
+                ast.Name(id=bname, ctx=ast.Load()), _args_tuple(carried),
+                prior)
+        target = ast.Tuple(elts=[ast.Name(id=a, ctx=ast.Store())
+                                 for a in [tgt] + carried],
+                           ctx=ast.Store())
+        return [bfn, ast.Assign(targets=[target], value=call)]
+
+
 class _NeedsTransform(ast.NodeVisitor):
-    """Cheap pre-scan: only rewrite sources that contain if/while at all."""
+    """Cheap pre-scan: only rewrite sources that contain control flow."""
     found = False
 
     def visit_If(self, node):
         self.found = True
 
     def visit_While(self, node):
+        self.found = True
+
+    def visit_For(self, node):
         self.found = True
 
 

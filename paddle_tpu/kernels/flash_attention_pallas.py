@@ -161,13 +161,25 @@ def _pick_fwd_head_group(h: int, d: int, s: int, hg_b: int) -> int:
     return hg_b
 
 
+#: VMEM allowance for the full-sequence lse+delta blocks the kernels keep
+#: resident per grid cell ((1,1,hg,nq,bq) each = hg*s*4 B); the rest of
+#: the 16 MB budget is operand blocks + scratch + double buffering
+_LSE_RESIDENCY_BUDGET = 8 * 1024 * 1024
+
+
 def max_supported_seq(h: int, d: int) -> int:
-    """Longest sequence the Pallas path supports end-to-end.  With the
-    split two-kernel backward (O(block) VMEM) the sequence length is no
-    longer VMEM-bound; the cap below is the point where the per-row lse
-    bookkeeping itself (b*h*s f32) stops being sensible on one chip —
-    beyond it the sequence axis should shard (ring/Ulysses, SURVEY §5.7)."""
-    return 256 * 1024
+    """Longest sequence the Pallas path supports end-to-end, derived from
+    the lse/delta VMEM residency at THIS (h, d)'s head group — a flat cap
+    admitted shapes (e.g. d=32 -> hg=8) whose hg*s*4-byte lse blocks fail
+    Mosaic allocation at compile time (ADVICE r3).  Beyond the cap the
+    sequence axis should shard (ring/Ulysses, SURVEY §5.7)."""
+    s = 256 * 1024
+    while s >= 1024:
+        hg = _pick_head_group(h, d, s)
+        if 2 * hg * s * 4 <= _LSE_RESIDENCY_BUDGET:
+            return s
+        s //= 2
+    return 1024
 
 
 # ---------------------------------------------------------------------------

