@@ -53,6 +53,18 @@ from . import framework     # noqa: E402
 from . import utils         # noqa: E402
 from . import incubate      # noqa: E402
 from . import fft           # noqa: E402
+from . import signal        # noqa: E402
+from . import linalg        # noqa: E402
+from . import regularizer   # noqa: E402
+from . import callbacks     # noqa: E402
+from . import hub           # noqa: E402
+from . import sysconfig     # noqa: E402
+from . import tensor        # noqa: E402
+from . import inference     # noqa: E402
+from . import reader        # noqa: E402
+from . import dataset       # noqa: E402
+from . import compat        # noqa: E402
+from .batch import batch    # noqa: E402
 from . import sparse        # noqa: E402
 from . import text          # noqa: E402
 from . import onnx          # noqa: E402

@@ -1,0 +1,24 @@
+"""paddle.batch — reader batching decorator (reference:
+python/paddle/batch.py:18)."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample generator into a mini-batch generator."""
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer value, "
+                         "but got batch_size={}".format(batch_size))
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,98 @@
+"""paddle.compat — string/number compatibility helpers (reference:
+python/paddle/compat.py: to_text:25, to_bytes:121, round:206,
+floor_division:232, get_exception_message:249)."""
+from __future__ import annotations
+
+import math
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+_builtin_round = round
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (recursively through list/set/dict) to str."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_text(o, encoding) for o in obj]
+            return obj
+        return [_to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_text(o, encoding) for o in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return {_to_text(o, encoding) for o in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            new = {_to_text(k, encoding): _to_text(v, encoding)
+                   for k, v in obj.items()}
+            obj.clear()
+            obj.update(new)
+            return obj
+        return {_to_text(k, encoding): _to_text(v, encoding)
+                for k, v in obj.items()}
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, (bytes, bytearray)):
+        return bytes(obj).decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (recursively through list/set) to bytes."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_bytes(o, encoding) for o in obj]
+            return obj
+        return [_to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_bytes(o, encoding) for o in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return {_to_bytes(o, encoding) for o in obj}
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, (bytes, bytearray)):
+        return bytes(obj)
+    return str(obj).encode(encoding)
+
+
+def round(x, d=0):
+    """Python2-style half-away-from-zero rounding."""
+    if x == float("inf") or x == -float("inf") or x != x:  # inf/nan
+        return x
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    if exc is None:
+        raise ValueError("exc should not be None")
+    return str(exc)

@@ -1,0 +1,21 @@
+"""paddle.dataset — legacy reader-protocol dataset creators (reference:
+python/paddle/dataset/__init__.py).  Each submodule exposes train()/test()
+reader creators (zero-arg callables yielding samples) wrapping the modern
+class-based datasets in paddle_tpu.vision.datasets / paddle_tpu.text —
+same on-disk formats, legacy feeding protocol."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import conll05  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "flowers", "voc2012", "imdb",
+           "imikolov", "movielens", "uci_housing", "conll05", "wmt14",
+           "wmt16"]

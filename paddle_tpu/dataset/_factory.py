@@ -1,0 +1,11 @@
+"""Shared reader-creator factory for the legacy dataset modules."""
+from __future__ import annotations
+
+
+def reader_from(cls, mode, **kw):
+    """Wrap a class-based Dataset into a legacy reader creator."""
+    def reader():
+        ds = cls(mode=mode, **kw)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader

@@ -1,0 +1,21 @@
+"""CIFAR reader creators (reference dataset/cifar.py)."""
+from ..vision.datasets import Cifar10, Cifar100
+from ._factory import reader_from
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def train10(data_file=None, **kw):
+    return reader_from(Cifar10, "train", data_file=data_file, **kw)
+
+
+def test10(data_file=None, **kw):
+    return reader_from(Cifar10, "test", data_file=data_file, **kw)
+
+
+def train100(data_file=None, **kw):
+    return reader_from(Cifar100, "train", data_file=data_file, **kw)
+
+
+def test100(data_file=None, **kw):
+    return reader_from(Cifar100, "test", data_file=data_file, **kw)

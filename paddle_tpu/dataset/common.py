@@ -1,0 +1,24 @@
+"""paddle.dataset.common (reference dataset/common.py): md5file and the
+cache-home convention.  download() needs network egress, which this build
+does not have — it raises with the local-path recipe instead."""
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    raise RuntimeError(
+        "paddle.dataset download requires network access, which this "
+        "build does not have. Place the archive under %s/%s and pass its "
+        "path to the dataset constructor." % (DATA_HOME, module_name))

@@ -1,0 +1,10 @@
+"""CoNLL-2005 SRL reader creators (reference dataset/conll05.py)."""
+from ..text import Conll05st
+from ._factory import reader_from
+
+__all__ = ["test"]
+
+
+def test(**kw):
+    # the reference ships only the public test split (conll05.py:24)
+    return reader_from(Conll05st, "test", **kw)

@@ -1,0 +1,17 @@
+"""Flowers-102 reader creators (reference dataset/flowers.py)."""
+from ..vision.datasets import Flowers
+from ._factory import reader_from
+
+__all__ = ["train", "test", "valid"]
+
+
+def train(**kw):
+    return reader_from(Flowers, "train", **kw)
+
+
+def test(**kw):
+    return reader_from(Flowers, "test", **kw)
+
+
+def valid(**kw):
+    return reader_from(Flowers, "valid", **kw)

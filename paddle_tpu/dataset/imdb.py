@@ -1,0 +1,13 @@
+"""IMDB sentiment reader creators (reference dataset/imdb.py)."""
+from ..text import Imdb
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(word_idx=None, **kw):
+    return reader_from(Imdb, "train", **kw)
+
+
+def test(word_idx=None, **kw):
+    return reader_from(Imdb, "test", **kw)

@@ -1,0 +1,13 @@
+"""PTB/imikolov LM reader creators (reference dataset/imikolov.py)."""
+from ..text import Imikolov
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(word_idx=None, n=5, **kw):
+    return reader_from(Imikolov, "train", window_size=n, **kw)
+
+
+def test(word_idx=None, n=5, **kw):
+    return reader_from(Imikolov, "test", window_size=n, **kw)

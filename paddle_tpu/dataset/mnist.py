@@ -1,0 +1,15 @@
+"""MNIST reader creators (reference dataset/mnist.py)."""
+from ..vision.datasets import MNIST
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(image_path=None, label_path=None, **kw):
+    return reader_from(MNIST, "train", image_path=image_path,
+                       label_path=label_path, **kw)
+
+
+def test(image_path=None, label_path=None, **kw):
+    return reader_from(MNIST, "test", image_path=image_path,
+                       label_path=label_path, **kw)

@@ -1,0 +1,13 @@
+"""MovieLens reader creators (reference dataset/movielens.py)."""
+from ..text import Movielens
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(**kw):
+    return reader_from(Movielens, "train", **kw)
+
+
+def test(**kw):
+    return reader_from(Movielens, "test", **kw)

@@ -1,0 +1,13 @@
+"""UCI housing reader creators (reference dataset/uci_housing.py)."""
+from ..text import UCIHousing
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(**kw):
+    return reader_from(UCIHousing, "train", **kw)
+
+
+def test(**kw):
+    return reader_from(UCIHousing, "test", **kw)

@@ -1,0 +1,17 @@
+"""VOC2012 segmentation reader creators (reference dataset/voc2012.py)."""
+from ..vision.datasets import VOC2012
+from ._factory import reader_from
+
+__all__ = ["train", "test", "val"]
+
+
+def train(**kw):
+    return reader_from(VOC2012, "train", **kw)
+
+
+def test(**kw):
+    return reader_from(VOC2012, "test", **kw)
+
+
+def val(**kw):
+    return reader_from(VOC2012, "valid", **kw)

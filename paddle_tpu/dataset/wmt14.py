@@ -1,0 +1,13 @@
+"""WMT14 en-fr reader creators (reference dataset/wmt14.py)."""
+from ..text import WMT14
+from ._factory import reader_from
+
+__all__ = ["train", "test"]
+
+
+def train(dict_size=-1, **kw):
+    return reader_from(WMT14, "train", **kw)
+
+
+def test(dict_size=-1, **kw):
+    return reader_from(WMT14, "test", **kw)

@@ -1,0 +1,17 @@
+"""WMT16 multimodal en-de reader creators (reference dataset/wmt16.py)."""
+from ..text import WMT16
+from ._factory import reader_from
+
+__all__ = ["train", "test", "validation"]
+
+
+def train(src_dict_size=-1, trg_dict_size=-1, **kw):
+    return reader_from(WMT16, "train", **kw)
+
+
+def test(src_dict_size=-1, trg_dict_size=-1, **kw):
+    return reader_from(WMT16, "test", **kw)
+
+
+def validation(src_dict_size=-1, trg_dict_size=-1, **kw):
+    return reader_from(WMT16, "val", **kw)

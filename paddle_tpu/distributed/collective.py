@@ -66,6 +66,20 @@ _groups = {}
 _default_axis = "dp"
 
 
+def ensure_varying(arr, axis):
+    """Promote a constant to device-varying for scan carries inside
+    shard_map (vma typing on newer jax).  pcast is the current spelling;
+    pvary is the deprecated one (ADVICE r4: the silent no-op fallback
+    would break carries once pvary is removed — pcast-first avoids it)."""
+    try:
+        return jax.lax.pcast(arr, axis, to="varying")
+    except (AttributeError, TypeError, ValueError):
+        try:
+            return jax.lax.pvary(arr, axis)
+        except (AttributeError, ValueError):
+            return arr
+
+
 def _axis_of(group) -> str:
     if group is None:
         return _default_axis

@@ -39,23 +39,35 @@ _NEG_INF = -1e30
 DEFAULT_CHUNK = 512
 
 
+#: below this admitted chunk width the inner scan degenerates into many
+#: tiny sequential steps (a prime s_loc would otherwise silently run
+#: chunk=1 — ~512x more scan steps; ADVICE r4)
+_CHUNK_FLOOR = 128
+
+
 def _chunk_for(s_blk: int, chunk: int) -> int:
     """Largest divisor of the K/V block length not exceeding ``chunk``."""
     c = min(chunk, s_blk)
     while s_blk % c:
         c -= 1
+    if c < min(_CHUNK_FLOOR, s_blk):
+        import warnings
+        warnings.warn(
+            "ring attention inner chunk degraded to %d for shard length "
+            "%d (no divisor <= %d above %d) — pad the sequence shard to a "
+            "multiple of a power of two to avoid a ~%dx slower inner scan"
+            % (c, s_blk, chunk, _CHUNK_FLOOR, max(1, _CHUNK_FLOOR // c)))
     return c
 
 
 def _pvary(a, axis_name):
     """newer jax: scan carries inside shard_map are vma-typed; constants
-    must be promoted to device-varying before entering the carry."""
+    must be promoted to device-varying before entering the carry (shared
+    pcast-first helper — ADVICE r4)."""
     if axis_name is None:
         return a
-    try:
-        return jax.lax.pvary(a, axis_name)
-    except (AttributeError, ValueError):
-        return a
+    from .collective import ensure_varying
+    return ensure_varying(a, axis_name)
 
 
 def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
@@ -76,7 +88,30 @@ def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
     backward recomputes the chunk logits instead of saving them.
     """
     b, h, sq, d = q.shape
+    hk = k_blk.shape[1]
     sk = k_blk.shape[2]
+    if h % hk:
+        raise ValueError(
+            "q heads (%d) must be a multiple of k/v heads (%d)" % (h, hk))
+    g = h // hk
+    rows = g * sq
+    if g > 1:
+        # grouped-query attention: fold the g query heads sharing one K/V
+        # head into the ROW axis ((b, hk, g*sq, d) — rows ordered g-major),
+        # so the contraction batches over the hk axis and K/V stay grouped
+        # (this is what keeps ring wire bytes 1/g of dense, r4 Weak #4)
+        q = q.reshape(b, hk, rows, d)
+        if mask_blk is not None:
+            if mask_blk.ndim == 4 and mask_blk.shape[1] == h:
+                # per-q-head mask follows the head fold exactly
+                mask_blk = mask_blk.reshape(b, hk, rows,
+                                            mask_blk.shape[-1])
+            else:
+                # head-broadcast mask: repeat its row axis g times (the
+                # row fold is (g, sq) — g-major)
+                reps = [1] * mask_blk.ndim
+                reps[-2] = g
+                mask_blk = jnp.tile(mask_blk, reps)
     c = _chunk_for(sk, chunk)
     nck = sk // c
 
@@ -86,7 +121,7 @@ def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
         vs = jax.lax.dynamic_slice_in_dim(v_blk, ci * c, c, 2)
         logits = jax.lax.dot_general(
             q, ks, (((3,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32) * scale    # (B, H, Sq, c)
+            preferred_element_type=jnp.float32) * scale  # (B, HK, rows, c)
         if mask_blk is not None:
             mb = jax.lax.dynamic_slice_in_dim(
                 mask_blk, ci * c, c, mask_blk.ndim - 1)
@@ -94,10 +129,13 @@ def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
         if diag:
             # elementwise causality on global positions — only the SELF
             # shard takes this branch (strictly-past shards run the
-            # mask-free trace; strictly-future ones are skipped upstream)
-            q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, c), 0)
+            # mask-free trace; strictly-future ones are skipped upstream).
+            # With GQA the row axis is (g, sq) flattened: position = row
+            # mod sq
+            row_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, c), 0)
+            q_pos = q_off + jax.lax.rem(row_iota, jnp.int32(sq))
             k_pos = k_off + ci * c + jax.lax.broadcasted_iota(
-                jnp.int32, (sq, c), 1)
+                jnp.int32, (rows, c), 1)
             logits = jnp.where((k_pos <= q_pos)[None, None], logits,
                                jnp.float32(_NEG_INF))
         new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
@@ -109,14 +147,18 @@ def _blockwise_attn(q, k_blk, v_blk, scale, q_off, k_off, diag, mask_blk,
             preferred_element_type=jnp.float32)
         return (new_m, new_l, new_acc), None
 
-    init = (_pvary(jnp.full((b, h, sq), _NEG_INF, jnp.float32), axis_name),
-            _pvary(jnp.zeros((b, h, sq), jnp.float32), axis_name),
-            _pvary(jnp.zeros((b, h, sq, d), jnp.float32), axis_name))
+    init = (_pvary(jnp.full((b, hk, rows), _NEG_INF, jnp.float32),
+                   axis_name),
+            _pvary(jnp.zeros((b, hk, rows), jnp.float32), axis_name),
+            _pvary(jnp.zeros((b, hk, rows, d), jnp.float32), axis_name))
     (m, l, acc), _ = jax.lax.scan(jax.checkpoint(body), init,
                                   jnp.arange(nck, dtype=jnp.int32))
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe[..., None]
     lse = m + jnp.log(l_safe)
+    if g > 1:
+        out = out.reshape(b, h, sq, d)
+        lse = lse.reshape(b, h, sq)
     return out, lse
 
 
@@ -136,12 +178,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
-    if k.shape[1] != h:
+    if h % k.shape[1]:
         raise NotImplementedError(
-            "ring_attention: grouped-query/multi-query attention (k heads "
-            "%d != q heads %d) is not supported under the 'sep' ring — "
-            "repeat K/V heads before sharding or gather the sequence"
-            % (k.shape[1], h))
+            "ring_attention: q heads (%d) must be a multiple of k/v heads "
+            "(%d) for grouped-query attention under the 'sep' ring"
+            % (h, k.shape[1]))
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     scale = jnp.float32(scale)

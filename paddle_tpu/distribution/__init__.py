@@ -17,6 +17,10 @@ def _arr(x):
     return x._array if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
 
 
+from .exponential_family import ExponentialFamily as \
+    _ExponentialFamilyMixin  # noqa: E402
+
+
 class Distribution:
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(batch_shape)
@@ -164,7 +168,7 @@ class Categorical(Distribution):
         return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
 
 
-class Beta(Distribution):
+class Beta(_ExponentialFamilyMixin, Distribution):
     def __init__(self, alpha, beta):
         self.alpha = _arr(alpha)
         self.beta = _arr(beta)
@@ -194,8 +198,27 @@ class Beta(Distribution):
         return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
                       + (a + b - 2) * dg(a + b))
 
+    # exponential-family hooks (reference beta.py:155)
+    @property
+    def _natural_parameters(self):
+        return (Tensor(self.alpha), Tensor(self.beta))
 
-class Dirichlet(Distribution):
+    def _log_normalizer(self, x, y):
+        gl = jax.scipy.special.gammaln
+        return Tensor(gl(_arr(x)) + gl(_arr(y)) - gl(_arr(x) + _arr(y)))
+
+    @property
+    def _mean_carrier_measure(self):
+        # E[-log x - log(1-x)] under Beta(a, b) — with naturals (a, b) the
+        # carrier is k(x) = -log x - log(1-x).  (The reference leaves this
+        # NotImplemented and overrides entropy; providing it makes the
+        # Bregman entropy exact.)
+        dg = jax.scipy.special.digamma
+        a, b = self.alpha, self.beta
+        return -(dg(a) - dg(a + b)) - (dg(b) - dg(a + b))
+
+
+class Dirichlet(_ExponentialFamilyMixin, Distribution):
     def __init__(self, concentration):
         self.concentration = _arr(concentration)
         super().__init__(self.concentration.shape[:-1],
@@ -212,6 +235,24 @@ class Dirichlet(Distribution):
         norm = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
                 - jax.scipy.special.gammaln(jnp.sum(c, axis=-1)))
         return Tensor(jnp.sum((c - 1) * jnp.log(v), axis=-1) - norm)
+
+    # exponential-family hooks (reference dirichlet.py:147)
+    @property
+    def _natural_parameters(self):
+        return (Tensor(self.concentration),)
+
+    def _log_normalizer(self, x):
+        gl = jax.scipy.special.gammaln
+        a = _arr(x)
+        return Tensor(jnp.sum(gl(a), axis=-1) - gl(jnp.sum(a, axis=-1)))
+
+    @property
+    def _mean_carrier_measure(self):
+        # E[-sum(log x_i)] under Dirichlet(c) (see Beta note above)
+        dg = jax.scipy.special.digamma
+        c = self.concentration
+        c0 = jnp.sum(c, axis=-1, keepdims=True)
+        return -jnp.sum(dg(c) - dg(c0), axis=-1)
 
 
 class Exponential(Distribution):
@@ -292,8 +333,58 @@ class Multinomial(Distribution):
                       + jnp.sum(v * logp, axis=-1))
 
 
+#: user-registered KL implementations (reference kl.py:64 register_kl)
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a kl_divergence implementation for a
+    (type(p), type(q)) pair — reference kl.py:64."""
+    if not (isinstance(cls_p, type) and isinstance(cls_q, type)):
+        raise TypeError("register_kl expects two Distribution classes")
+
+    def deco(f):
+        _KL_REGISTRY[(cls_p, cls_q)] = f
+        return f
+    return deco
+
+
+def _kl_expfamily_expfamily(p, q):
+    """KL between two SAME-family exponential-family distributions via the
+    Bregman divergence of the log-normalizer (reference kl.py
+    _kl_expfamily_expfamily): KL(p||q) = F(θ_q) - F(θ_p) - <θ_q-θ_p, ∇F(θ_p)>."""
+    from .exponential_family import ExponentialFamily
+    if type(p) is not type(q) or not isinstance(p, ExponentialFamily):
+        raise NotImplementedError(
+            "exponential-family KL needs two instances of the same "
+            "ExponentialFamily subclass")
+    p_nat = [jnp.asarray(_arr(x), jnp.float32)
+             for x in p._natural_parameters]
+    q_nat = [jnp.asarray(_arr(x), jnp.float32)
+             for x in q._natural_parameters]
+
+    def log_norm(params):
+        return jnp.sum(_arr(p._log_normalizer(
+            *[Tensor(x) for x in params])))
+
+    _, grads = jax.value_and_grad(log_norm)(tuple(p_nat))
+    lq = _arr(q._log_normalizer(*[Tensor(x) for x in q_nat]))
+    kl = lq - _arr(p._log_normalizer(*[Tensor(x) for x in p_nat]))
+    for pn, qn, g in zip(p_nat, q_nat, grads):
+        # - <θ_q - θ_p, ∇F(θ_p)>  ==  + (θ_p - θ_q)·∇F(θ_p)
+        term = (pn - qn) * g
+        extra = term.ndim - kl.ndim
+        if extra > 0:
+            term = jnp.sum(term, axis=tuple(range(-extra, 0)))
+        kl = kl + term
+    return Tensor(kl)
+
+
 def kl_divergence(p: Distribution, q: Distribution):
     """reference: python/paddle/distribution/kl.py."""
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = jnp.square(p.scale / q.scale)
         t1 = jnp.square((p.loc - q.loc) / q.scale)
@@ -316,5 +407,27 @@ def kl_divergence(p: Distribution, q: Distribution):
             gl(pa + pb) - gl(pa) - gl(pb) - gl(qa + qb) + gl(qa) + gl(qb)
             + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
             + (qa - pa + qb - pb) * dg(pa + pb))
+    # same-family exponential-family pairs fall back to the Bregman form
+    # (reference kl.py dispatch order)
+    from .exponential_family import ExponentialFamily as _EF
+    if type(p) is type(q) and isinstance(p, _EF):
+        try:
+            return _kl_expfamily_expfamily(p, q)
+        except NotImplementedError:
+            pass
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+# -- round-5 additions: transforms / wrappers (reference transform.py:59,
+# transformed_distribution.py:22, independent.py:18,
+# exponential_family.py) ----------------------------------------------------
+from .exponential_family import ExponentialFamily  # noqa: E402,F401
+from .independent import Independent  # noqa: E402,F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402,F401
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+from .transformed_distribution import \
+    TransformedDistribution  # noqa: E402,F401

@@ -1,0 +1,55 @@
+"""paddle.distribution.ExponentialFamily (reference:
+python/paddle/distribution/exponential_family.py): entropy via the Bregman
+divergence of the log-normalizer — the gradient comes from jax.grad
+instead of the reference's paddle.grad tape."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ExponentialFamily"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class ExponentialFamily:
+    """Mixin/base for distributions of the form
+    f(x; theta) = exp(<t(x), theta> - F(theta) + k(x)).
+
+    Subclasses provide ``_natural_parameters`` (tuple of Tensors),
+    ``_log_normalizer(*naturals)`` and ``_mean_carrier_measure``."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """H = F(theta) - <theta, grad F(theta)> - E[k(x)] (Bregman —
+        reference exponential_family.py entropy)."""
+        naturals = [_arr(p) for p in self._natural_parameters]
+        grads = jax.grad(lambda ps: jnp.sum(_arr(self._log_normalizer(
+            *[Tensor(p) for p in ps]))))(tuple(naturals))
+        log_norm = _arr(self._log_normalizer(
+            *[Tensor(p) for p in naturals]))
+        entropy_value = -jnp.asarray(self._mean_carrier_measure) + log_norm
+        for p, g in zip(naturals, grads):
+            term = p * g
+            # natural params may carry event dims beyond the batch shape
+            # (e.g. Dirichlet's concentration vector): <θ, ∇F> contracts
+            # them
+            extra = term.ndim - log_norm.ndim
+            if extra > 0:
+                term = jnp.sum(term, axis=tuple(range(-extra, 0)))
+            entropy_value = entropy_value - term
+        return Tensor(entropy_value)

@@ -1,0 +1,70 @@
+"""paddle.distribution.Independent (reference:
+python/paddle/distribution/independent.py:18): reinterpret rightmost batch
+dims as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Independent"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Independent:
+    def __init__(self, base, reinterpreted_batch_rank):
+        from . import Distribution
+        if not isinstance(base, Distribution):
+            raise TypeError("Expected type of 'base' is Distribution, but "
+                            "got %s" % type(base).__name__)
+        if not 0 < reinterpreted_batch_rank <= len(base.batch_shape):
+            raise ValueError(
+                "Expected 0 < reinterpreted_batch_rank <= %d, but got %d"
+                % (len(base.batch_shape), reinterpreted_batch_rank))
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cut = len(base.batch_shape) - self._reinterpreted_batch_rank
+        self._batch_shape = shape[:cut]
+        self._event_shape = shape[cut:]
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        return Tensor(self._sum_rightmost(
+            _arr(self._base.log_prob(value)),
+            self._reinterpreted_batch_rank))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        return Tensor(self._sum_rightmost(
+            _arr(self._base.entropy()), self._reinterpreted_batch_rank))
+
+    @staticmethod
+    def _sum_rightmost(value, n):
+        return jnp.sum(value, axis=tuple(range(-n, 0))) if n > 0 else value

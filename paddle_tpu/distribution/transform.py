@@ -1,0 +1,563 @@
+"""paddle.distribution.transform — differentiable bijections of random
+variables (reference: python/paddle/distribution/transform.py:59 Transform
+and its 12 concrete subclasses).
+
+TPU-native: the math is jnp (traced, autodiff-safe); the API speaks
+Tensors.  ``t(distribution)`` builds a TransformedDistribution, ``t(other
+transform)`` composes a ChainTransform — the reference's __call__
+dispatch."""
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class Type(enum.Enum):
+    """Mapping type of a transformation (reference transform.py:44)."""
+    BIJECTION = "bijection"     # bijective: one-to-one and onto
+    INJECTION = "injection"     # one-to-one
+    SURJECTION = "surjection"   # onto
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class _Domain:
+    """Light rendering of the reference's variable.Variable: just the
+    event_rank and a name (constraint checking is the caller's job under
+    XLA's static world)."""
+
+    def __init__(self, event_rank=0, name="real"):
+        self.event_rank = int(event_rank)
+        self.name = name
+
+    def __repr__(self):
+        return "_Domain(%s, event_rank=%d)" % (self.name, self.event_rank)
+
+
+real = _Domain(0, "real")
+positive = _Domain(0, "positive")
+
+
+class Transform:
+    r"""Base class (reference transform.py:59): subclasses implement
+    ``_forward``/``_inverse``/``_forward_log_det_jacobian`` (and the shape
+    methods when the shape changes)."""
+
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        from . import Distribution
+        from .transformed_distribution import TransformedDistribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(_t(input))
+
+    # -- public API ---------------------------------------------------------
+    def forward(self, x):
+        """y = f(x)."""
+        return _t(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        """x = f^{-1}(y)."""
+        return _t(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        """log|det J_f(x)|."""
+        a = _arr(x)
+        if hasattr(type(self), "_forward_log_det_jacobian") and \
+                type(self)._forward_log_det_jacobian is not \
+                Transform._forward_log_det_jacobian:
+            return _t(self._forward_log_det_jacobian(a))
+        if type(self)._inverse_log_det_jacobian is not \
+                Transform._inverse_log_det_jacobian:
+            return _t(-self._inverse_log_det_jacobian(self._forward(a)))
+        raise NotImplementedError(
+            "Neither _forward_log_det_jacobian nor "
+            "_inverse_log_det_jacobian is implemented.")
+
+    def inverse_log_det_jacobian(self, y):
+        """log|det J_{f^{-1}}(y)| = -forward_log_det_jacobian(f^{-1}(y))."""
+        a = _arr(y)
+        if type(self)._inverse_log_det_jacobian is not \
+                Transform._inverse_log_det_jacobian:
+            return _t(self._inverse_log_det_jacobian(a))
+        return _t(-_arr(self.forward_log_det_jacobian(self._inverse(a))))
+
+    def forward_shape(self, shape):
+        return self._forward_shape(tuple(shape))
+
+    def inverse_shape(self, shape):
+        return self._inverse_shape(tuple(shape))
+
+    @property
+    def _domain(self):
+        return real
+
+    @property
+    def _codomain(self):
+        return real
+
+    # -- subclass hooks -----------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def _inverse_log_det_jacobian(self, y):
+        raise NotImplementedError
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    r"""y = |x| (reference transform.py:327).  Non-injective: ``inverse``
+    returns the positive preimage; log-det is undefined."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class AffineTransform(Transform):
+    r"""y = loc + scale * x (reference transform.py:399)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _arr(loc)
+        self._scale = _arr(scale)
+
+    @property
+    def loc(self):
+        return _t(self._loc)
+
+    @property
+    def scale(self):
+        return _t(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    r"""y = exp(x) (reference transform.py:600)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class PowerTransform(Transform):
+    r"""y = x^power over the positive reals (reference transform.py:740)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _arr(power)
+
+    @property
+    def power(self):
+        return _t(self._power)
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(x, self._power - 1)))
+
+    @property
+    def _domain(self):
+        return positive
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class SigmoidTransform(Transform):
+    r"""y = 1/(1+exp(-x)) (reference transform.py:910)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+    @property
+    def _codomain(self):
+        return _Domain(0, "unit_interval")
+
+
+class TanhTransform(Transform):
+    r"""y = tanh(x) (reference transform.py:1178)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # numerically-stable log(1 - tanh^2): 2(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return _Domain(0, "interval(-1, 1)")
+
+
+class SoftmaxTransform(Transform):
+    r"""y = softmax over the last axis (reference transform.py:953).
+    Not injective (softmax is shift-invariant): no log-det."""
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    @property
+    def _domain(self):
+        return _Domain(1, "real_vector")
+
+    @property
+    def _codomain(self):
+        return _Domain(1, "simplex")
+
+
+class StickBreakingTransform(Transform):
+    r"""Unconstrained R^K -> (K+1)-simplex via stick-breaking (reference
+    transform.py:1114)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zp_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(0, 1)]
+        z_padded = jnp.pad(z, pad_width, constant_values=1.0)
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+        zp_padded = jnp.pad(zp_cumprod, pad_width, constant_values=1.0)
+        return z_padded * zp_padded
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y.shape[-1] - jnp.arange(1, y_crop.shape[-1] + 1)
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        x = jnp.log(y_crop / sf) + jnp.log(offset.astype(y.dtype))
+        return x
+
+    def _forward_log_det_jacobian(self, x):
+        # triangular Jacobian: log|det| = sum_k(-x'_k + logsigmoid(x'_k)
+        # + log y_k), x' = x - log(offset) — the log1p(-z)=logsigmoid(-x')
+        # identity keeps it stable
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        xs = x - jnp.log(offset.astype(x.dtype))
+        y = self._forward(x)
+        return jnp.sum(-xs + jax.nn.log_sigmoid(xs)
+                       + jnp.log(y[..., :-1]), axis=-1)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError("StickBreakingTransform needs rank >= 1")
+        return shape[:-1] + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape or shape[-1] < 2:
+            raise ValueError("inverse_shape needs last dim >= 2")
+        return shape[:-1] + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return _Domain(1, "real_vector")
+
+    @property
+    def _codomain(self):
+        return _Domain(1, "simplex")
+
+
+class ReshapeTransform(Transform):
+    r"""Reshape the event shape (reference transform.py:803)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        in_event_shape = tuple(in_event_shape)
+        out_event_shape = tuple(out_event_shape)
+        if functools.reduce(operator.mul, in_event_shape, 1) != \
+                functools.reduce(operator.mul, out_event_shape, 1):
+            raise ValueError(
+                "in_event_shape %r and out_event_shape %r have different "
+                "sizes" % (in_event_shape, out_event_shape))
+        self._in_event_shape = in_event_shape
+        self._out_event_shape = out_event_shape
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self._in_event_shape)]
+        return x.reshape(batch + self._out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self._out_event_shape)]
+        return y.reshape(batch + self._in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self._in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def _forward_shape(self, shape):
+        n = len(self._in_event_shape)
+        if len(shape) < n or tuple(shape[len(shape) - n:]) != \
+                self._in_event_shape:
+            raise ValueError("shape %r does not end in in_event_shape %r"
+                             % (shape, self._in_event_shape))
+        return tuple(shape[:len(shape) - n]) + self._out_event_shape
+
+    def _inverse_shape(self, shape):
+        n = len(self._out_event_shape)
+        if len(shape) < n or tuple(shape[len(shape) - n:]) != \
+                self._out_event_shape:
+            raise ValueError("shape %r does not end in out_event_shape %r"
+                             % (shape, self._out_event_shape))
+        return tuple(shape[:len(shape) - n]) + self._in_event_shape
+
+    @property
+    def _domain(self):
+        return _Domain(len(self._in_event_shape), "real")
+
+    @property
+    def _codomain(self):
+        return _Domain(len(self._out_event_shape), "real")
+
+
+class IndependentTransform(Transform):
+    r"""Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims
+    as event dims: sums that many rightmost dims out of the base's
+    log-det (reference transform.py:649)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+
+    def _forward(self, x):
+        return _arr(self._base.forward(x))
+
+    def _inverse(self, y):
+        return _arr(self._base.inverse(y))
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = _arr(self._base.forward_log_det_jacobian(x))
+        return jnp.sum(ldj, axis=tuple(
+            range(-self._reinterpreted_batch_rank, 0)))
+
+    def _forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return _Domain(self._base._domain.event_rank
+                       + self._reinterpreted_batch_rank,
+                       self._base._domain.name)
+
+    @property
+    def _codomain(self):
+        return _Domain(self._base._codomain.event_rank
+                       + self._reinterpreted_batch_rank,
+                       self._base._codomain.name)
+
+
+class ChainTransform(Transform):
+    r"""Composition f = f_n o ... o f_1 applied left-to-right (reference
+    transform.py:476: forward applies in sequence order)."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError("transforms must be a sequence of Transform")
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("All elements must be Transform instances")
+        self.transforms = list(transforms)
+        if not all(t._is_injective() for t in self.transforms):
+            self._type = Type.OTHER
+        else:
+            self._type = Type.INJECTION
+
+    def _is_injective(self):
+        return Type.is_injective(self._type)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = _arr(t.forward(x))
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = _arr(t.inverse(y))
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            ldj = _arr(t.forward_log_det_jacobian(x))
+            value = value + _sum_rightmost(
+                ldj, event_rank - t._domain.event_rank)
+            x = _arr(t.forward(x))
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+        return value
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+    @property
+    def _domain(self):
+        rank = 0
+        for t in reversed(self.transforms):
+            rank = max(rank + t._domain.event_rank
+                       - t._codomain.event_rank, t._domain.event_rank)
+        return _Domain(rank, "chain")
+
+    @property
+    def _codomain(self):
+        rank = 0
+        for t in self.transforms:
+            rank = max(rank + t._codomain.event_rank
+                       - t._domain.event_rank, t._codomain.event_rank)
+        return _Domain(rank, "chain")
+
+
+class StackTransform(Transform):
+    r"""Apply a sequence of transforms to slices along ``axis``
+    (reference transform.py:1009)."""
+
+    def __init__(self, transforms, axis=0):
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be non-empty Transforms")
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _split(self, x):
+        if x.shape[self._axis] != len(self._transforms):
+            raise ValueError(
+                "input size along axis %d (%d) must equal the number of "
+                "transforms (%d)" % (self._axis, x.shape[self._axis],
+                                     len(self._transforms)))
+        return [jnp.squeeze(s, self._axis) for s in
+                jnp.split(x, len(self._transforms), axis=self._axis)]
+
+    def _forward(self, x):
+        return jnp.stack([_arr(t.forward(s)) for t, s in
+                          zip(self._transforms, self._split(x))],
+                         axis=self._axis)
+
+    def _inverse(self, y):
+        return jnp.stack([_arr(t.inverse(s)) for t, s in
+                          zip(self._transforms, self._split(y))],
+                         axis=self._axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack([_arr(t.forward_log_det_jacobian(s)) for t, s in
+                          zip(self._transforms, self._split(x))],
+                         axis=self._axis)
+
+
+def _sum_rightmost(value, n):
+    return jnp.sum(value, axis=tuple(range(-n, 0))) if n > 0 else value

@@ -1,0 +1,93 @@
+"""paddle.distribution.TransformedDistribution (reference:
+python/paddle/distribution/transformed_distribution.py:22): a base
+distribution pushed through a sequence of Transforms."""
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import transform as T
+from .independent import Independent
+
+__all__ = ["TransformedDistribution"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _sum_rightmost(value, n):
+    return jnp.sum(value, axis=tuple(range(-n, 0))) if n > 0 else value
+
+
+class TransformedDistribution:
+    def __init__(self, base, transforms):
+        from . import Distribution
+        if not isinstance(base, (Distribution, Independent)):
+            raise TypeError("Expected type of 'base' is Distribution, but "
+                            "got %s." % type(base).__name__)
+        if not isinstance(transforms, typing.Sequence):
+            raise TypeError("Expected type of 'transforms' is "
+                            "Sequence[Transform], but got %s."
+                            % type(transforms).__name__)
+        if not all(isinstance(t, T.Transform) for t in transforms):
+            raise TypeError("All elements of transforms must be Transform.")
+        chain = T.ChainTransform(list(transforms))
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        if len(base_shape) < chain._domain.event_rank:
+            raise ValueError(
+                "'base' needs to have shape with size at least %d, but got "
+                "%d." % (chain._domain.event_rank, len(base_shape)))
+        if chain._domain.event_rank > len(base.event_shape):
+            base = Independent(
+                base, chain._domain.event_rank - len(base.event_shape))
+        self._base = base
+        self._transforms = list(transforms)
+        transformed_shape = chain.forward_shape(
+            tuple(base.batch_shape) + tuple(base.event_shape))
+        transformed_event_rank = chain._codomain.event_rank + \
+            max(len(base.event_shape) - chain._domain.event_rank, 0)
+        cut = len(transformed_shape) - transformed_event_rank
+        self._batch_shape = tuple(transformed_shape[:cut])
+        self._event_shape = tuple(transformed_shape[cut:])
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        log_prob = 0.0
+        y = _arr(value)
+        event_rank = len(self.event_shape)
+        for t in reversed(self._transforms):
+            x = _arr(t.inverse(y))
+            event_rank += t._domain.event_rank - t._codomain.event_rank
+            log_prob = log_prob - _sum_rightmost(
+                _arr(t.forward_log_det_jacobian(x)),
+                event_rank - t._domain.event_rank)
+            y = x
+        log_prob = log_prob + _sum_rightmost(
+            _arr(self._base.log_prob(Tensor(y))),
+            event_rank - len(self._base.event_shape))
+        return Tensor(log_prob)
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))

@@ -1,0 +1,92 @@
+"""paddle.hub — hubconf-protocol model loading (reference:
+python/paddle/hapi/hub.py list:170 / help:214 / load:256).
+
+``source='local'`` is fully supported: a repo directory containing
+``hubconf.py`` whose public callables are the entrypoints (the reference's
+``dependencies`` variable is honoured).  ``github``/``gitee`` sources
+require network egress, which this build does not have — they raise a
+curated error instead of silently hanging."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+_builtin_list = list  # shadowed by the API name below
+
+
+def _no_network(source):
+    raise RuntimeError(
+        "paddle.hub source=%r requires network access, which this build "
+        "does not have (zero-egress TPU environment). Clone the repository "
+        "locally and call with source='local'." % (source,))
+
+
+def _import_hubconf(repo_dir):
+    repo_dir = os.path.expanduser(repo_dir)
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError("Cannot find %s in %r" % (MODULE_HUBCONF,
+                                                          repo_dir))
+    sys.path.insert(0, repo_dir)
+    try:
+        spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                      path)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = []
+        for pkg in deps:
+            try:
+                __import__(pkg)
+            except ImportError:
+                missing.append(pkg)
+        if missing:
+            raise RuntimeError("Missing dependencies: %s"
+                               % ", ".join(missing))
+    return m
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            'Unknown source: "%s". Allowed values: "github" | "gitee" | '
+            '"local".' % (source,))
+    if source in ("github", "gitee"):
+        _no_network(source)
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """List entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    m = _import_hubconf(repo_dir)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Return the docstring of one entrypoint."""
+    _check_source(source)
+    m = _import_hubconf(repo_dir)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError("Cannot find callable %s in hubconf" % (model,))
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call entrypoint ``model`` from the repo's hubconf.py."""
+    _check_source(source)
+    m = _import_hubconf(repo_dir)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError("Cannot find callable %s in hubconf" % (model,))
+    return fn(**kwargs)

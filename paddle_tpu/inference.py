@@ -1,0 +1,145 @@
+"""paddle.inference — the deployment predictor API (reference:
+python/paddle/inference/__init__.py over
+fluid/inference/api/paddle_inference_api.h: Config, Predictor,
+create_predictor, get_version).
+
+The engine is the exported StableHLO artifact (static.load_inference_model
+/ SURVEY §2.1 N27); Config points at the same two-file prefix the
+reference's (prog_file, params_file) pair uses."""
+from __future__ import annotations
+
+import enum
+import os
+
+from . import __version__ as _version
+from .static import load_inference_model
+
+__all__ = ["Config", "DataType", "PlaceType", "PrecisionType", "Tensor",
+           "Predictor", "create_predictor", "get_version"]
+
+
+class DataType(enum.Enum):
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType(enum.Enum):
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kNPU = 3
+    kIPU = 4
+    kTPU = 5
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class Config:
+    """Predictor configuration (reference paddle_analysis_config.h).  The
+    artifact prefix comes from ``prog_file`` minus its extension (both
+    artifact files share the prefix)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is None:
+            raise ValueError("Config needs the exported artifact: "
+                             "Config('<prefix>.pdmodel', "
+                             "'<prefix>.pdiparams')")
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file.endswith(".pdmodel") else prog_file)
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    # accepted-and-ignored knobs (XLA owns placement/precision here; kept
+    # so reference deployment scripts run unchanged)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, *a, **k):
+        pass
+
+    def enable_memory_optim(self, *a, **k):
+        pass
+
+
+class Tensor:
+    """Named handle mirroring the reference's ZeroCopyTensor flow."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, data):
+        self._value = data
+
+    def copy_to_cpu(self):
+        import numpy as np
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(getattr(self._value, "shape", ()))
+
+
+class Predictor:
+    """reference Predictor (paddle_inference_api.h): named-handle feed /
+    run / named-handle fetch over the loaded artifact."""
+
+    def __init__(self, config: Config):
+        if not os.path.exists(config._prefix + ".pdiparams"):
+            raise FileNotFoundError(
+                "no artifact at prefix %r (expected .pdiparams/.pdmodel "
+                "from static.save_inference_model)" % (config._prefix,))
+        self._impl = load_inference_model(config._prefix)
+        self._inputs = {n: Tensor(n) for n in self._impl.feed_names}
+        self._outputs = {n: Tensor(n) for n in self._impl.fetch_names}
+
+    def get_input_names(self):
+        return list(self._impl.feed_names)
+
+    def get_output_names(self):
+        return list(self._impl.fetch_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self):
+        feeds = [self._inputs[n]._value for n in self._impl.feed_names]
+        outs = self._impl.run(feeds)
+        names = self._impl.fetch_names or [
+            "fetch_%d" % i for i in range(len(outs))]
+        for n, o in zip(names, outs):
+            self._outputs.setdefault(n, Tensor(n))._value = o.numpy()
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version():
+    return _version

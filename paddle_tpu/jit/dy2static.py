@@ -23,14 +23,28 @@ data-INdependent control flow needs no rewrite under jax tracing anyway):
   ``return convert_ifelse(...)``.
 * ``while`` whose body assigns previously-bound names: loop-carried
   variables are every name assigned in the body that is bound before the
-  loop; ``break``/``continue``/``return`` inside are not supported.
+  loop.
 * ``for i in range(...)`` — lax.fori_loop over a computed trip count when
   any bound is a tensor (step must be concrete); ``for x in tensor`` —
   lax.scan over the leading axis; ``for x in <python iterable>`` keeps
   plain-Python unrolling.  Same carried-variable rules as ``while``;
-  ``break``/``continue``/``return`` and tuple targets raise.
+  tuple targets raise.
   (reference: loop_transformer.py:1, convert_operators.py convert_len /
   convert_while_loop)
+* ``break``/``continue``/``return`` inside converted loops — desugared by
+  a pre-pass into boolean guard flags threaded through the loop carry
+  (reference scheme: break_continue_transformer.py:87 BreakContinue,
+  return_transformer.py:136 ReturnTransformer): ``break`` sets a carried
+  flag that both guards the remaining body and joins the loop condition;
+  ``continue`` sets a per-iteration flag guarding the rest of the body;
+  ``return expr`` sets a return flag + value, and the statements after the
+  loop move into the else of an ``if <ret-flag>: return <value>``.  Loops
+  with interrupts lower to ``while`` (early exit stops compute — a
+  fori/scan cannot stop early).  Scope: ``return`` is supported in loops
+  at function-body top level whose return expression is computable before
+  the loop (the lax carry needs a typed initial value — the reference's
+  RETURN_NO_VALUE magic-number trick, rendered statically); bare and
+  valued returns cannot mix in one loop.
 """
 from __future__ import annotations
 
@@ -296,6 +310,86 @@ def convert_iter_for(xs, body_fn: Callable, args: tuple, prior=UNDEFINED):
     return (cur,) + tuple(out)
 
 
+def convert_logical_not(x):
+    """Traced-safe ``not`` for generated guard tests."""
+    a = _as_array(x)
+    if _is_traced(a) or isinstance(a, jax.Array):
+        return jnp.logical_not(jnp.asarray(a).astype(bool))
+    return not bool(a)
+
+
+def convert_logical_or(*xs):
+    arrs = [_as_array(x) for x in xs]
+    if any(_is_traced(a) or isinstance(a, jax.Array) for a in arrs):
+        out = jnp.asarray(False)
+        for a in arrs:
+            out = jnp.logical_or(out, jnp.asarray(a).astype(bool))
+        return out
+    return any(bool(a) for a in arrs)
+
+
+def convert_logical_and(*xs):
+    arrs = [_as_array(x) for x in xs]
+    if any(_is_traced(a) or isinstance(a, jax.Array) for a in arrs):
+        out = jnp.asarray(True)
+        for a in arrs:
+            out = jnp.logical_and(out, jnp.asarray(a).astype(bool))
+        return out
+    return all(bool(a) for a in arrs)
+
+
+def convert_len(xs):
+    """len() over tensors (leading axis, static) or Python sequences."""
+    if _is_tensorish(xs):
+        return int(_as_array(xs).shape[0])
+    return len(xs)
+
+
+def convert_index(xs, i):
+    """xs[i] with a possibly-traced integer index."""
+    from ..core.tensor import Tensor
+    if _is_tensorish(xs):
+        a = _as_array(xs)
+        idx = _as_array(i)
+        return Tensor(jnp.take(a, jnp.asarray(idx, jnp.int32), axis=0))
+    if _is_traced(i):
+        raise Dy2StaticUnsupportedError(
+            "indexing a plain Python sequence with a traced loop index — a "
+            "loop over a Python iterable cannot break on a tensor "
+            "condition under tracing; convert the iterable to a tensor")
+    return xs[int(_as_array(i)) if _is_tensorish(i) else i]
+
+
+def convert_range_cond(i, stop, step):
+    """The `i vs stop` test of a desugared range loop; step must be
+    concrete (the comparison direction is its sign)."""
+    if _is_traced(_as_array(step)):
+        raise Dy2StaticUnsupportedError(
+            "a converted `for i in range(...)` with break/continue/return "
+            "needs a CONCRETE step")
+    step_i = int(_as_array(step)) if _is_tensorish(step) else int(step)
+    if step_i == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    ia, sa = _as_array(i), _as_array(stop)
+    if step_i > 0:
+        return ia < sa
+    return ia > sa
+
+
+def _retval_init(thunk):
+    """Pre-loop evaluation of a loop-return expression, used to give the
+    lax carry a typed initial value; unbound names fall back to UNDEFINED
+    (fails later with the bound-before error only if tracing needs it)."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError, AttributeError, IndexError,
+            TypeError):
+        # IndexError/TypeError: the typed pre-binding of a for-iter target
+        # indexes element 0 — an EMPTY iterable must not fail here (the
+        # loop body never runs; plain Python would leave the name unbound)
+        return UNDEFINED
+
+
 # ---------------------------------------------------------------------------
 # AST transformer (reference: ifelse_transformer.py / loop_transformer.py)
 # ---------------------------------------------------------------------------
@@ -303,10 +397,24 @@ def convert_iter_for(xs, body_fn: Callable, args: tuple, prior=UNDEFINED):
 _RT = "__dy2static_rt"
 
 
+def _walk_same_scope(st):
+    """ast.walk that does NOT descend into nested function definitions —
+    a generated branch fn's `return`/assignments are local to it, not to
+    the statement list being analysed."""
+    stack = [st]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def _store_names(stmts) -> set:
     names = set()
     for st in stmts:
-        for node in ast.walk(st):
+        for node in _walk_same_scope(st):
             if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
                 names.add(node.id)
             elif isinstance(node, ast.AugAssign) and isinstance(
@@ -317,7 +425,7 @@ def _store_names(stmts) -> set:
 
 def _has_stmt(stmts, kinds) -> bool:
     return any(isinstance(node, kinds)
-               for st in stmts for node in ast.walk(st))
+               for st in stmts for node in _walk_same_scope(st))
 
 
 def _ends_in_return(stmts) -> bool:
@@ -359,6 +467,340 @@ def _args_tuple(names):
         ctx=ast.Load())
 
 
+def _name_load(n):
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _owned_interrupts(body):
+    """(has_break, has_continue, has_return) belonging to THIS loop body —
+    interrupts inside nested loops belong to those loops; nested function
+    defs own their returns."""
+    brk = cont = ret = False
+
+    def walk(stmts, nested):
+        nonlocal brk, cont, ret
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(st, (ast.While, ast.For)):
+                walk(st.body, True)
+                walk(st.orelse, True)
+            elif isinstance(st, ast.If):
+                walk(st.body, nested)
+                walk(st.orelse, nested)
+            elif isinstance(st, ast.Break):
+                brk = brk or not nested
+            elif isinstance(st, ast.Continue):
+                cont = cont or not nested
+            elif isinstance(st, ast.Return):
+                ret = ret or not nested
+
+    walk(body, False)
+    return brk, cont, ret
+
+
+class _LoopDesugarCtx:
+    """Names + usage record for one loop's interrupt flags (reference:
+    break_continue_transformer.py's generated __break_/__continue_ vars)."""
+
+    def __init__(self, uid):
+        self.brk = "__jst_brk_%d" % uid
+        self.cont = "__jst_cont_%d" % uid
+        self.ret = "__jst_ret_%d" % uid
+        self.retval = "__jst_retval_%d" % uid
+        self.used_brk = self.used_cont = self.used_ret = False
+        self.ret_values = []     # Return.value nodes (None for bare)
+
+    def exit_flags(self):
+        return [f for f, u in ((self.brk, self.used_brk),
+                               (self.ret, self.used_ret)) if u]
+
+    def all_flags(self):
+        return [f for f, u in ((self.brk, self.used_brk),
+                               (self.cont, self.used_cont),
+                               (self.ret, self.used_ret)) if u]
+
+    def valued_ret(self):
+        vals = [v is not None for v in self.ret_values]
+        if vals and any(vals) and not all(vals):
+            raise Dy2StaticUnsupportedError(
+                "a converted loop cannot mix bare `return` and "
+                "`return <value>` (one carried return slot)")
+        return bool(vals) and vals[0]
+
+
+def _guard_test(ctx):
+    flags = [_name_load(f) for f in ctx.all_flags()]
+    if len(flags) == 1:
+        return _call_rt("convert_logical_not", flags[0])
+    return _call_rt("convert_logical_not",
+                    _call_rt("convert_logical_or", *flags))
+
+
+def _rewrite_interrupt_stmt(st, ctx, allow_return):
+    """-> (replacement stmts, may_set_flag)."""
+    if isinstance(st, ast.Break):
+        ctx.used_brk = True
+        return [_assign(ctx.brk, ast.Constant(True))], True
+    if isinstance(st, ast.Continue):
+        ctx.used_cont = True
+        return [_assign(ctx.cont, ast.Constant(True))], True
+    if isinstance(st, ast.Return):
+        if not allow_return:
+            raise Dy2StaticUnsupportedError(
+                "`return` inside a converted loop is supported only when "
+                "the loop sits directly in the function body (the "
+                "statements after it become the return-dispatch else "
+                "branch); restructure the nested loop")
+        ctx.used_ret = True
+        ctx.ret_values.append(st.value)
+        out = [_assign(ctx.ret, ast.Constant(True))]
+        if st.value is not None:
+            out.append(_assign(ctx.retval, st.value))
+        return out, True
+    if isinstance(st, ast.If):
+        body, b_set = _rewrite_interrupt_stmts(st.body, ctx, allow_return)
+        orelse, o_set = _rewrite_interrupt_stmts(st.orelse, ctx,
+                                                 allow_return)
+        if b_set or o_set:
+            return [ast.If(test=st.test, body=body, orelse=orelse)], True
+        return [st], False
+    if isinstance(st, (ast.While, ast.For)):
+        # nested loop: its own break/continue were desugared by the child
+        # visit; a surviving Return inside raises in that visit
+        return [st], False
+    for node in ast.walk(st):
+        if isinstance(node, (ast.Break, ast.Continue, ast.Return)) and \
+                not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise Dy2StaticUnsupportedError(
+                "break/continue/return inside a `%s` statement in a "
+                "converted loop is not supported"
+                % type(st).__name__.lower())
+    return [st], False
+
+
+def _rewrite_interrupt_stmts(stmts, ctx, allow_return):
+    """Boolean-guard rewrite of one statement list: statements after a
+    possible flag-set point are wrapped in `if not <flags>:` (reference
+    break_continue_transformer.py:87 scheme)."""
+    out = []
+    for idx, st in enumerate(stmts):
+        new, sets = _rewrite_interrupt_stmt(st, ctx, allow_return)
+        out.extend(new)
+        if sets and idx < len(stmts) - 1:
+            rest, _ = _rewrite_interrupt_stmts(stmts[idx + 1:], ctx,
+                                               allow_return)
+            out.append(ast.If(test=_guard_test(ctx), body=rest, orelse=[]))
+            return out, True
+        if sets:
+            return out, True
+    return out, False
+
+
+def _flag_inits(ctx):
+    pre = [_assign(f, ast.Constant(False)) for f in ctx.all_flags()]
+    if ctx.used_ret and ctx.valued_ret():
+        # typed initial value for the lax carry: the return expression
+        # evaluated BEFORE the loop (the reference's RETURN_NO_VALUE
+        # magic-number trick, rendered statically); unbound names fall
+        # back to UNDEFINED via _retval_init
+        first = next(v for v in ctx.ret_values if v is not None)
+        lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=first)
+        pre.append(_assign(ctx.retval, _call_rt("_retval_init", lam)))
+    return pre
+
+
+def _augmented_test(test, ctx):
+    exits = [_name_load(f) for f in ctx.exit_flags()]
+    if not exits:
+        return test
+    inner = exits[0] if len(exits) == 1 else _call_rt(
+        "convert_logical_or", *exits)
+    return _call_rt("convert_logical_and", test,
+                    _call_rt("convert_logical_not", inner))
+
+
+def _guarded_tail(ctx, stmts):
+    """Append loop-footer statements (cursor increments) guarded so a
+    break/return iteration leaves the cursor untouched."""
+    if not ctx.exit_flags():
+        return stmts
+    exits = [_name_load(f) for f in ctx.exit_flags()]
+    inner = exits[0] if len(exits) == 1 else _call_rt(
+        "convert_logical_or", *exits)
+    return [ast.If(test=_call_rt("convert_logical_not", inner),
+                   body=stmts, orelse=[])]
+
+
+def _desugar_while(node, ctx, allow_return):
+    if node.orelse:
+        raise Dy2StaticUnsupportedError("while/else is not supported")
+    body, _ = _rewrite_interrupt_stmts(node.body, ctx, allow_return)
+    if ctx.used_cont:
+        body = [_assign(ctx.cont, ast.Constant(False))] + body
+    loop = ast.While(test=_augmented_test(node.test, ctx), body=body,
+                     orelse=[])
+    return _flag_inits(ctx), loop
+
+
+def _desugar_for(node, ctx, uid, allow_return):
+    """for-with-interrupts lowers to a while (early exit must stop the
+    loop — a fori/scan cannot); the loop target tracks the last iteration
+    that RAN, matching Python's post-loop binding."""
+    if node.orelse:
+        raise Dy2StaticUnsupportedError("for/else is not supported")
+    if not isinstance(node.target, ast.Name):
+        raise Dy2StaticUnsupportedError(
+            "only `for <name> in ...` is convertible (tuple unpacking "
+            "targets are not)")
+    tgt = node.target.id
+    body, _ = _rewrite_interrupt_stmts(node.body, ctx, allow_return)
+    cursor = "__jst_it_%d" % uid
+    is_range = (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords)
+    pre = []
+    if is_range:
+        lo, hi, step = "__jst_lo_%d" % uid, "__jst_hi_%d" % uid, \
+            "__jst_st_%d" % uid
+        rargs = list(node.iter.args)
+        if len(rargs) == 1:
+            rargs = [ast.Constant(0), rargs[0], ast.Constant(1)]
+        elif len(rargs) == 2:
+            rargs = rargs + [ast.Constant(1)]
+        pre += [_assign(lo, rargs[0]), _assign(hi, rargs[1]),
+                _assign(step, rargs[2]), _assign(cursor, _name_load(lo)),
+                _assign(tgt, _name_load(lo))]
+        test = _call_rt("convert_range_cond", _name_load(cursor),
+                        _name_load(hi), _name_load(step))
+        bump = _assign(cursor, ast.BinOp(left=_name_load(cursor),
+                                         op=ast.Add(),
+                                         right=_name_load(step)))
+    else:
+        xs, n = "__jst_xs_%d" % uid, "__jst_n_%d" % uid
+        zero_lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=_call_rt("convert_index", _name_load(xs),
+                          ast.Constant(0)))
+        pre += [_assign(xs, node.iter),
+                _assign(n, _call_rt("convert_len", _name_load(xs))),
+                _assign(cursor, ast.Constant(0)),
+                # typed pre-binding of the target for the lax carry
+                _assign(tgt, _call_rt("_retval_init", zero_lam))]
+        test = ast.Compare(left=_name_load(cursor), ops=[ast.Lt()],
+                           comparators=[_name_load(n)])
+        bump = _assign(cursor, ast.BinOp(left=_name_load(cursor),
+                                         op=ast.Add(),
+                                         right=ast.Constant(1)))
+    cont_reset = ([_assign(ctx.cont, ast.Constant(False))]
+                  if ctx.used_cont else [])
+    tgt_bind = ([_assign(tgt, _name_load(cursor))] if is_range else
+                [_assign(tgt, _call_rt("convert_index", _name_load(xs),
+                                       _name_load(cursor)))])
+    full_body = cont_reset + tgt_bind + body + _guarded_tail(ctx, [bump])
+    loop = ast.While(test=_augmented_test(test, ctx), body=full_body,
+                     orelse=[])
+    return _flag_inits(ctx) + pre, loop
+
+
+def _flatten_stmts(stmts):
+    """visit() may return lists (desugared loops) — flatten on EVERY
+    exit path (an early return with a nested list dies in compile())."""
+    flat = []
+    for st in stmts:
+        flat.extend(st if isinstance(st, list) else [st])
+    return flat
+
+
+class _InterruptDesugarer(ast.NodeTransformer):
+    """Pre-pass: rewrite break/continue/return in loops into guard flags
+    (reference: break_continue_transformer.py + return_transformer.py).
+    Runs before _ControlFlowTransformer, whose plain while/if converters
+    then lower the result."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _next_uid(self):
+        self._uid += 1
+        return self._uid
+
+    def visit_FunctionDef(self, node):
+        node.body = self._process_body(node.body)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _process_body(self, stmts):
+        """Function-body statement list: loops with `return` inside get
+        the return-dispatch treatment (the function tail moves into the
+        else branch)."""
+        out = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, (ast.While, ast.For)) \
+                    and _owned_interrupts(st.body)[2]:
+                self.generic_visit(st)          # nested loops first
+                uid = self._next_uid()
+                ctx = _LoopDesugarCtx(uid)
+                if isinstance(st, ast.While):
+                    pre, loop = _desugar_while(st, ctx, allow_return=True)
+                else:
+                    pre, loop = _desugar_for(st, ctx, uid,
+                                             allow_return=True)
+                tail = self._process_body(list(stmts[idx + 1:]))
+                if not _ends_in_return(tail):
+                    tail = tail + [ast.Return(value=ast.Constant(None))]
+                ret_stmt = (ast.Return(value=_name_load(ctx.retval))
+                            if ctx.valued_ret()
+                            else ast.Return(value=ast.Constant(None)))
+                out.extend(pre)
+                out.append(loop)
+                out.append(ast.If(test=_name_load(ctx.ret),
+                                  body=[ret_stmt], orelse=tail))
+                return _flatten_stmts(out)
+            out.append(self.visit(st))
+        return _flatten_stmts(out)
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        brk, cont, ret = _owned_interrupts(node.body)
+        if ret:
+            raise Dy2StaticUnsupportedError(
+                "`return` inside a converted loop is supported only when "
+                "the loop sits directly in the function body")
+        if not (brk or cont):
+            return node
+        ctx = _LoopDesugarCtx(self._next_uid())
+        pre, loop = _desugar_while(node, ctx, allow_return=False)
+        return pre + [loop]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        brk, cont, ret = _owned_interrupts(node.body)
+        if ret:
+            raise Dy2StaticUnsupportedError(
+                "`return` inside a converted loop is supported only when "
+                "the loop sits directly in the function body")
+        if not (brk or cont):
+            return node
+        uid = self._next_uid()
+        ctx = _LoopDesugarCtx(uid)
+        pre, loop = _desugar_for(node, ctx, uid, allow_return=False)
+        return pre + [loop]
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._uid = 0
@@ -387,15 +829,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
         tname, fname = self._next("true"), self._next("false")
         if body_returns:
-            # both branches return: wrap bodies, return the dispatch
-            tfn = _make_branch_fn(tname, [], body, extra_return=False)
+            # both branches return: wrap bodies, return the dispatch.
+            # Names a branch REASSIGNS become parameters — a zero-arg
+            # closure would make them function-local and die with
+            # UnboundLocalError on a read-then-write like `x = x + 1`
+            assigned = sorted(_store_names(body) | _store_names(orelse))
+            tfn = _make_branch_fn(tname, assigned, body, extra_return=False)
             ffn = _make_branch_fn(
-                fname, [], orelse or [ast.Return(value=ast.Constant(None))],
+                fname, assigned,
+                orelse or [ast.Return(value=ast.Constant(None))],
                 extra_return=False)
             call = _call_rt("convert_ifelse", node.test,
                             ast.Name(id=tname, ctx=ast.Load()),
                             ast.Name(id=fname, ctx=ast.Load()),
-                            ast.Tuple(elts=[], ctx=ast.Load()))
+                            _args_tuple(assigned))
             return [tfn, ffn, ast.Return(value=call)]
 
         assigned = sorted(_store_names(body) | _store_names(orelse))
@@ -539,6 +986,7 @@ def transform_function(fn: Callable):
     func_def.decorator_list = []  # do not re-apply @to_static etc.
     new_name = func_def.name + "__dy2static"
     func_def.name = new_name
+    tree = ast.fix_missing_locations(_InterruptDesugarer().visit(tree))
     tree = ast.fix_missing_locations(
         _ControlFlowTransformer().visit(tree))
 

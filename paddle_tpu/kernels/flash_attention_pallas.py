@@ -411,6 +411,23 @@ def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
 # backward (merged dQ/dK/dV)
 # ---------------------------------------------------------------------------
 
+def _apply_causal_split(compute, causal, qi, ki, block_q, block_k):
+    """Run ``compute(masked)`` under the causal block taxonomy: skipped
+    (strictly-future), fully-visible (no mask arithmetic), or diagonal
+    band (mask applied).  Non-causal runs unconditionally unmasked."""
+    if not causal:
+        compute(False)
+        return
+    first_row = jax.lax.mul(qi, _i32(block_q))
+    last_row = first_row + _i32(block_q - 1)
+    first_col = jax.lax.mul(ki, _i32(block_k))
+    last_col = first_col + _i32(block_k - 1)
+    fully_visible = last_col <= first_row
+    diagonal = jnp.logical_and(last_col > first_row, first_col <= last_row)
+    pl.when(fully_visible)(lambda: compute(False))
+    pl.when(diagonal)(lambda: compute(True))
+
+
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc, *,
                 causal, scale, hg, d, nq, nk):
@@ -428,14 +445,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    live = True
-    if causal:
-        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
-            jax.lax.mul(ki, _i32(block_k))
-
-    @pl.when(live)
-    def _compute():
-        if causal:
+    def _compute(masked):
+        if masked:
             row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
@@ -454,7 +465,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)          # (BQ, BK)
             p = jnp.exp2(logits - lse[:, None])
-            if causal:
+            if masked:
                 p = jnp.where(mask, p, jnp.float32(0.0))
             pc = p.astype(do.dtype)
             # dV += P^T dO
@@ -474,6 +485,11 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dq_sc[pl.ds(row0, block_q), sl] + jax.lax.dot_general(
                     ds, k, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
+
+    # fully-visible blocks skip the iota/where mask arithmetic entirely —
+    # only the diagonal band pays it (the same split the streamed forward
+    # uses; the two pl.when conditions are disjoint)
+    _apply_causal_split(_compute, causal, qi, ki, block_q, block_k)
 
     @pl.when(qi == nq - 1)
     def _finalize_kv():
@@ -499,14 +515,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    live = True
-    if causal:
-        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
-            jax.lax.mul(ki, _i32(block_k))
-
-    @pl.when(live)
-    def _compute():
-        if causal:
+    def _compute(masked):
+        if masked:
             row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
@@ -524,7 +534,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             p = jnp.exp2(logits - lse[:, None])
-            if causal:
+            if masked:
                 p = jnp.where(mask, p, jnp.float32(0.0))
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -533,6 +543,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dq_sc[:, sl] = dq_sc[:, sl] + jax.lax.dot_general(
                 ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+
+    _apply_causal_split(_compute, causal, qi, ki, block_q, block_k)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -554,14 +566,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    live = True
-    if causal:
-        live = jax.lax.mul(qi, _i32(block_q)) + _i32(block_q - 1) >= \
-            jax.lax.mul(ki, _i32(block_k))
-
-    @pl.when(live)
-    def _compute():
-        if causal:
+    def _compute(masked):
+        if masked:
             row_ids = jax.lax.mul(qi, _i32(block_q))[None, None] + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             col_ids = jax.lax.mul(ki, _i32(block_k))[None, None] + \
@@ -579,7 +585,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             p = jnp.exp2(logits - lse[:, None])
-            if causal:
+            if masked:
                 p = jnp.where(mask, p, jnp.float32(0.0))
             pc = p.astype(do.dtype)
             dv_sc[:, sl] = dv_sc[:, sl] + jax.lax.dot_general(
@@ -592,6 +598,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk_sc[:, sl] = dk_sc[:, sl] + jax.lax.dot_general(
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+
+    _apply_causal_split(_compute, causal, qi, ki, block_q, block_k)
 
     @pl.when(qi == nq - 1)
     def _finalize():

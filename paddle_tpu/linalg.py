@@ -1,0 +1,15 @@
+"""paddle.linalg — namespace re-exports (reference: python/paddle/linalg.py,
+a pure re-export of tensor.linalg).  The implementations live in
+paddle_tpu.ops.linalg; this module mirrors the reference's import surface."""
+from .ops import (cholesky, cholesky_solve, cond, cov, det, eig, eigh,
+                  eigvals, eigvalsh, lstsq, lu, lu_unpack, matrix_power,
+                  matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve,
+                  svd, triangular_solve)
+from .ops import inverse as inv
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "inv", "eig", "eigvals", "multi_dot",
+    "matrix_rank", "svd", "qr", "lu", "lu_unpack", "matrix_power", "det",
+    "slogdet", "eigh", "eigvalsh", "pinv", "solve", "cholesky_solve",
+    "triangular_solve", "lstsq",
+]

@@ -81,13 +81,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                         "attention dropout under sequence parallelism, or "
                         "pass sequence_parallel=False if the sequence was "
                         "already gathered")
-                if q.shape[2] != k.shape[2]:
+                if q.shape[2] % k.shape[2]:
                     # curated error before ring_attention's einsum would
-                    # die with an opaque shape mismatch (ADVICE r3)
+                    # die with an opaque shape mismatch (ADVICE r3);
+                    # divisible head counts route as grouped-query (the
+                    # ring rotates the GROUPED K/V — wire bytes shrink by
+                    # the group factor, r4 Weak #4)
                     raise NotImplementedError(
-                        "grouped-query/multi-query attention (q heads %d, "
-                        "k heads %d) is not supported under the 'sep' "
-                        "ring — repeat K/V heads before sharding"
+                        "grouped-query attention under the 'sep' ring "
+                        "needs q heads (%d) divisible by k/v heads (%d)"
                         % (q.shape[2], k.shape[2]))
                 mask = None
                 if m is not None:

@@ -37,6 +37,8 @@ class Optimizer:
         self.regularization = weight_decay
         self._grad_clip = grad_clip
         self._wd = self._coeff(weight_decay)
+        # regularizer.L1Decay objects flip the coupled term to wd*sign(p)
+        self._wd_mode = getattr(weight_decay, "_mode", "l2")
         self._accumulators: Dict[int, dict] = {}
         self._step_count = 0
         self._jit_update = None

@@ -13,8 +13,11 @@ from .optimizer import Optimizer
 
 
 def _wd_grad(self, g, p):
-    """Coupled (L2) weight decay: g + wd * p."""
+    """Coupled weight decay: g + wd * p (L2Decay / float) or
+    g + wd * sign(p) (regularizer.L1Decay)."""
     if self._wd and not self._decoupled_wd:
+        if getattr(self, "_wd_mode", "l2") == "l1":
+            return g + jnp.asarray(self._wd, g.dtype) * jnp.sign(p)
         return g + jnp.asarray(self._wd, g.dtype) * p
     return g
 

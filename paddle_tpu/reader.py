@@ -1,0 +1,226 @@
+"""paddle.reader — reader-decorator utilities (reference:
+python/paddle/reader/decorator.py: cache:52, map_readers:92, shuffle:134,
+chain:183, compose:248, buffered:308, firstn:367, xmap_readers:412,
+multiprocess_reader:505).
+
+A "reader creator" is a zero-arg callable returning an iterable of samples
+(the reference's legacy data-feeding protocol, kept for API parity next to
+the io.DataLoader path)."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Cache the reader's data in memory on first pass."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        for item in all_data:
+            yield item
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) zipped over the readers."""
+    def reader():
+        rs = [r() for r in readers]
+        for e in map(func, *rs):
+            yield e
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle within windows of ``buf_size`` samples."""
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back."""
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples: (a, b, c) per step.
+
+    check_alignment=True (default) raises ComposeNotAligned when the
+    readers have different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                for o in outputs:
+                    if o is None:
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned.")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Pre-read up to ``size`` samples on a background thread."""
+    class _End:
+        pass
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(_End())
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while not isinstance(e, _End):
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first ``n`` samples."""
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply ``mapper`` over the reader with ``process_num`` worker threads
+    (the reference uses threads too, decorator.py:412)."""
+    end = object()
+    in_order = order
+
+    def read_worker(r, in_q):
+        for i, d in enumerate(r()):
+            in_q.put((i, d) if in_order else d)
+        in_q.put(end)
+
+    def map_worker(in_q, out_q):
+        sample = in_q.get()
+        while sample is not end:
+            if in_order:
+                i, d = sample
+                out_q.put((i, mapper(d)))
+            else:
+                out_q.put(mapper(sample))
+            sample = in_q.get()
+        in_q.put(end)       # let sibling workers see the sentinel
+        out_q.put(end)
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        t = threading.Thread(target=read_worker, args=(reader, in_q))
+        t.daemon = True
+        t.start()
+        workers = []
+        for _ in range(process_num):
+            w = threading.Thread(target=map_worker, args=(in_q, out_q))
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finished = 0
+        next_idx = 0
+        pending = {}
+        while finished < process_num:
+            sample = out_q.get()
+            if sample is end:
+                finished += 1
+                continue
+            if in_order:
+                i, d = sample
+                pending[i] = d
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+            else:
+                yield sample
+        if in_order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (thread-backed here: jax
+    arrays do not pickle across fork, so the reference's fork/pipe scheme
+    is replaced by threads with identical yield semantics)."""
+    if len(readers) < 1:
+        raise ValueError("multiprocess_reader needs at least one reader")
+    end = object()
+
+    def worker(r, q):
+        try:
+            for sample in r():
+                if sample is None:
+                    raise ValueError("sample has None")
+                q.put(sample)
+        finally:
+            q.put(end)
+
+    def reader():
+        q = _queue.Queue(queue_size)
+        for r in readers:
+            t = threading.Thread(target=worker, args=(r, q))
+            t.daemon = True
+            t.start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is end:
+                finished += 1
+            else:
+                yield sample
+
+    return reader

@@ -1,0 +1,253 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference surface and semantics: python/paddle/signal.py (frame at :32,
+overlap_add at :154, stft at :237, istft at :391 — backed by the frame /
+overlap_add phi kernels and fft_r2c/c2c/c2r).
+
+TPU-native: frame is a static gather (the index grid is a compile-time
+constant, so XLA lowers it to strided slices); overlap_add is one
+scatter-add; the DFTs ride jnp.fft like paddle_tpu.fft.  All four are
+differentiable and jit-safe (static shapes from static frame/hop args).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import wrap_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_index_grid(frame_length, hop_length, num_frames, axis):
+    if axis == -1:
+        # [..., frame_length, num_frames]
+        return (np.arange(frame_length)[:, None]
+                + hop_length * np.arange(num_frames)[None, :])
+    # axis == 0: [num_frames, frame_length, ...]
+    return (hop_length * np.arange(num_frames)[:, None]
+            + np.arange(frame_length)[None, :])
+
+
+def _check_frame_args(frame_length, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    if not isinstance(frame_length, int) or frame_length <= 0:
+        raise ValueError(f"Unexpected frame_length: {frame_length}. "
+                         "It should be an positive integer.")
+    if not isinstance(hop_length, int) or hop_length <= 0:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. "
+                         "It should be an positive integer.")
+
+
+def _frame_raw(x, frame_length, hop_length, axis):
+    seq_len = x.shape[axis]
+    if frame_length > seq_len:
+        raise ValueError(
+            "Attribute frame_length should be less equal than sequence "
+            f"length, but got ({frame_length}) > ({seq_len}).")
+    num_frames = 1 + (seq_len - frame_length) // hop_length
+    idx = _frame_index_grid(frame_length, hop_length, num_frames, axis)
+    if axis == -1:
+        return x[..., idx]
+    return x[idx]
+
+
+@wrap_op
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into (overlapping) frames — reference signal.py:32.
+
+    axis=-1: [..., seq] -> [..., frame_length, num_frames];
+    axis=0:  [seq, ...] -> [num_frames, frame_length, ...]."""
+    _check_frame_args(frame_length, hop_length, axis)
+    return _frame_raw(x, frame_length, hop_length, axis)
+
+
+def _overlap_add_raw(x, hop_length, axis):
+    if axis == -1:
+        frame_length, num_frames = x.shape[-2], x.shape[-1]
+    else:
+        num_frames, frame_length = x.shape[0], x.shape[1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    idx = _frame_index_grid(frame_length, hop_length, num_frames, axis)
+    if axis == -1:
+        out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+        return out.at[..., idx].add(x)
+    out = jnp.zeros((out_len,) + x.shape[2:], x.dtype)
+    return out.at[idx].add(x)
+
+
+@wrap_op
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct by adding overlapping frames — reference signal.py:154.
+
+    axis=-1: [..., frame_length, num_frames] -> [..., seq];
+    axis=0:  [num_frames, frame_length, ...] -> [seq, ...]."""
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    if not isinstance(hop_length, int) or hop_length <= 0:
+        raise ValueError(f"Unexpected hop_length: {hop_length}. "
+                         "It should be an positive integer.")
+    if x.ndim < 2:
+        raise ValueError("overlap_add expects an input of at least rank 2, "
+                         f"got rank {x.ndim}")
+    return _overlap_add_raw(x, hop_length, axis)
+
+
+def _prep_window(window, win_length, n_fft, like_dtype):
+    if window is None:
+        window = jnp.ones((win_length,), like_dtype)
+    else:
+        window = jnp.asarray(window)
+        if window.ndim != 1 or window.shape[0] != win_length:
+            raise ValueError(
+                "expected a 1D window tensor of size equal to "
+                f"win_length({win_length}), but got window with shape "
+                f"{window.shape}.")
+    if win_length < n_fft:
+        pad_left = (n_fft - win_length) // 2
+        window = jnp.pad(window,
+                         (pad_left, n_fft - win_length - pad_left))
+    return window
+
+
+def _stft_raw(x, window, n_fft, hop_length, win_length, center, pad_mode,
+              normalized, onesided):
+    x_rank = x.ndim
+    if x_rank == 1:
+        x = x[None]
+    if center:
+        if pad_mode not in ("constant", "reflect"):
+            raise ValueError('pad_mode should be "reflect" or "constant", '
+                             f'but got "{pad_mode}".')
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=("reflect" if pad_mode == "reflect"
+                          else "constant"))
+    if n_fft > x.shape[-1]:
+        raise ValueError(f"n_fft should be in (0, seq_length"
+                         f"({x.shape[-1]})], but got {n_fft}.")
+    frames = _frame_raw(x, n_fft, hop_length, -1)      # (B, n_fft, T)
+    frames = jnp.swapaxes(frames, -1, -2)              # (B, T, n_fft)
+    frames = frames * window.astype(frames.dtype)
+    norm = "ortho" if normalized else "backward"
+    if jnp.iscomplexobj(frames):
+        out = jnp.fft.fft(frames, axis=-1, norm=norm)
+    elif onesided:
+        out = jnp.fft.rfft(frames, axis=-1, norm=norm)
+    else:
+        out = jnp.fft.fft(frames.astype(
+            jnp.complex64 if frames.dtype == jnp.float32
+            else jnp.complex128), axis=-1, norm=norm)
+    out = jnp.swapaxes(out, -1, -2)                    # (B, F, T)
+    if x_rank == 1:
+        out = out[0]
+    return out
+
+
+@wrap_op
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform — reference signal.py:237 semantics
+    (center/pad_mode/normalized/onesided, win_length center-padding)."""
+    if x.ndim not in (1, 2):
+        raise ValueError("x should be a 1D or 2D real tensor, but got rank "
+                         f"of x is {x.ndim}")
+    if hop_length is None:
+        hop_length = int(n_fft // 4)
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, but got {hop_length}.")
+    if win_length is None:
+        win_length = n_fft
+    if not 0 < win_length <= n_fft:
+        raise ValueError(f"win_length should be in (0, n_fft({n_fft})], "
+                         f"but got {win_length}.")
+    if jnp.iscomplexobj(x) and onesided:
+        raise ValueError("onesided should be False when input or window is "
+                         "a complex Tensor.")
+    win = _prep_window(window, win_length, n_fft,
+                       jnp.asarray(x).real.dtype)
+    return _stft_raw(jnp.asarray(x), win, n_fft, hop_length, win_length,
+                     center, pad_mode, normalized, onesided)
+
+
+@wrap_op
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (least-squares / NOLA-weighted overlap-add) — reference
+    signal.py:391 semantics incl. the NOLA constraint check."""
+    if x.ndim not in (2, 3):
+        raise ValueError("x should be a 2D or 3D complex tensor, but got "
+                         f"rank of x is {x.ndim}")
+    if not jnp.iscomplexobj(x):
+        raise TypeError("istft expects a complex input (the output of "
+                        "stft); got dtype %s" % (x.dtype,))
+    x_rank = x.ndim
+    if x_rank == 2:
+        x = x[None]
+    if hop_length is None:
+        hop_length = int(n_fft // 4)
+    if win_length is None:
+        win_length = n_fft
+    if not 0 < hop_length <= win_length:
+        raise ValueError(f"hop_length should be in (0, win_length"
+                         f"({win_length})], but got {hop_length}.")
+    if not 0 < win_length <= n_fft:
+        raise ValueError(f"win_length should be in (0, n_fft({n_fft})], "
+                         f"but got {win_length}.")
+    fft_size = x.shape[-2]
+    if onesided and fft_size != n_fft // 2 + 1:
+        raise ValueError("fft_size should be equal to n_fft // 2 + 1"
+                         f"({n_fft // 2 + 1}) when onesided is True, but "
+                         f"got {fft_size}.")
+    if not onesided and fft_size != n_fft:
+        raise ValueError(f"fft_size should be equal to n_fft({n_fft}) when "
+                         f"onesided is False, but got {fft_size}.")
+    real_dtype = (jnp.float32 if x.dtype == jnp.complex64 else jnp.float64)
+    win = _prep_window(window, win_length, n_fft, real_dtype)
+    if return_complex and onesided:
+        raise ValueError("onesided should be False when input(output of "
+                         "istft) or window is a complex Tensor.")
+    if not return_complex and jnp.iscomplexobj(win):
+        raise ValueError("Data type of window should not be complex when "
+                         "return_complex is False.")
+
+    n_frames = x.shape[-1]
+    frames = jnp.swapaxes(x, -1, -2)                   # (B, T, F)
+    norm = "ortho" if normalized else "backward"
+    if return_complex:
+        out = jnp.fft.ifft(frames, axis=-1, norm=norm)
+    else:
+        if not onesided:
+            frames = frames[..., :n_fft // 2 + 1]
+        out = jnp.fft.irfft(frames, n=n_fft, axis=-1, norm=norm)
+    out = out * win.astype(out.dtype)
+    out = jnp.swapaxes(out, -1, -2)                    # (B, n_fft, T)
+    out = _overlap_add_raw(out, hop_length, -1)        # (B, L)
+
+    env_frames = jnp.tile((win * win)[None], (n_frames, 1)).T  # (n_fft, T)
+    envelop = _overlap_add_raw(env_frames, hop_length, -1)     # (L,)
+
+    if length is None:
+        if center:
+            out = out[:, n_fft // 2:-(n_fft // 2)]
+            envelop = envelop[n_fft // 2:-(n_fft // 2)]
+    else:
+        start = n_fft // 2 if center else 0
+        out = out[:, start:start + length]
+        envelop = envelop[start:start + length]
+
+    if not isinstance(envelop, jax.core.Tracer):
+        if float(jnp.min(jnp.abs(envelop))) < 1e-11:
+            raise ValueError(
+                "Abort istft because Nonzero Overlap Add (NOLA) condition "
+                "failed. For more information about NOLA constraint please "
+                "see scipy.signal.check_NOLA.")
+    out = out / envelop.astype(out.dtype)
+    if x_rank == 2:
+        out = out[0]
+    return out

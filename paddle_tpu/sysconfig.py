@@ -1,0 +1,19 @@
+"""paddle.sysconfig — build-config introspection (reference:
+python/paddle/sysconfig.py get_include/get_lib)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the framework's C headers (the csrc shim ABI
+    used by utils.cpp_extension custom ops)."""
+    return os.path.join(os.path.dirname(_PKG_DIR), "csrc")
+
+
+def get_lib():
+    """Directory containing the framework's native shared libraries (built
+    on demand by core.native / utils.cpp_extension)."""
+    return os.path.join(os.path.dirname(_PKG_DIR), "csrc", "build")

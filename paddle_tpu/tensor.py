@@ -1,0 +1,10 @@
+"""paddle.tensor — the tensor-op namespace (reference:
+python/paddle/tensor/__init__.py, which re-exports the per-domain op
+modules math/linalg/creation/manipulation/...).
+
+In this build the ops live in paddle_tpu.ops (one dispatch layer over
+jnp/lax — SURVEY §2.3); this module mirrors the reference's namespace so
+``paddle.tensor.<op>`` resolves for every op the flat API exposes."""
+from .ops import *  # noqa: F401,F403
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -572,13 +572,66 @@ def test_ring_attention_bf16_rotation_and_gqa_guard(mesh8):
     np.testing.assert_allclose(np.asarray(out).astype(np.float32), full,
                                rtol=5e-2, atol=5e-2)
 
-    with _pytest.raises(NotImplementedError, match="grouped-query"):
-        kv2 = k[:, :1]
+    # (c) non-divisible head counts still raise the curated error
+    with _pytest.raises(NotImplementedError, match="multiple"):
+        q3 = jnp.concatenate([q, q, q], axis=1)       # 6 q heads
+        kv4 = jnp.concatenate([k, k], axis=1)         # 4 kv heads
         jax.jit(shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, "dp"),
             mesh=mesh8,
             in_specs=(PartitionSpec(None, None, "dp", None),) * 3,
-            out_specs=PartitionSpec(None, None, "dp", None)))(q, kv2, kv2)
+            out_specs=PartitionSpec(None, None, "dp", None)))(q3, kv4, kv4)
+
+
+def test_ring_attention_gqa_matches_dense(mesh8):
+    """Grouped-query attention under the 'sep' ring (r4 verdict #9): the
+    GROUPED K/V rotate (wire bytes 1/g of dense) and the result matches
+    dense GQA attention (K/V heads repeated) exactly."""
+    from paddle_tpu.distributed.ring_attention import ring_attention
+    from paddle_tpu.nn.functional.attention import sdpa_reference_raw
+
+    b, h, hk, s, d = 1, 4, 2, 64, 16
+    g = h // hk
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, hk, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, hk, s, d), jnp.float32)
+
+    ring = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "dp", causal=True),
+        mesh=mesh8,
+        in_specs=(PartitionSpec(None, None, "dp", None),) * 3,
+        out_specs=PartitionSpec(None, None, "dp", None))
+    out = jax.jit(ring)(q, k, v)
+
+    # dense reference: repeat each K/V head g times (contiguous groups)
+    k_rep = jnp.repeat(k, g, axis=1)
+    v_rep = jnp.repeat(v, g, axis=1)
+    full = sdpa_reference_raw(jnp.swapaxes(q, 1, 2),
+                              jnp.swapaxes(k_rep, 1, 2),
+                              jnp.swapaxes(v_rep, 1, 2), is_causal=True)
+    full = np.asarray(jnp.swapaxes(full, 1, 2))
+    np.testing.assert_allclose(np.asarray(out), full, rtol=2e-5, atol=2e-5)
+
+    # grads flow through the grouped ring
+    def loss(q_, k_, v_):
+        return jnp.sum(jax.jit(ring)(q_, k_, v_) ** 2)
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert gk.shape == k.shape and np.isfinite(np.asarray(gk)).all()
+
+    def loss_dense(q_, k_, v_):
+        o = sdpa_reference_raw(jnp.swapaxes(q_, 1, 2),
+                               jnp.swapaxes(jnp.repeat(k_, g, 1), 1, 2),
+                               jnp.swapaxes(jnp.repeat(v_, g, 1), 1, 2),
+                               is_causal=True)
+        return jnp.sum(jnp.swapaxes(o, 1, 2) ** 2)
+    dq, dk, dv = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(dq), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(dk), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(dv), rtol=2e-4,
+                               atol=2e-4)
 
 
 def test_ring_attention_long_seq_blockwise_memory(mesh8):

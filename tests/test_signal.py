@@ -1,0 +1,158 @@
+"""paddle.signal — frame/overlap_add/stft/istft (paddle_tpu/signal.py).
+
+Reference semantics: python/paddle/signal.py:32 (frame), :154 (overlap_add),
+:237 (stft), :391 (istft).  Values verified against scipy and numpy."""
+import numpy as np
+import pytest
+import scipy.signal as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+
+
+def test_frame_1d_axis_last_matches_reference_doc():
+    x = paddle.arange(8)
+    y = signal.frame(x, frame_length=4, hop_length=2, axis=-1)
+    np.testing.assert_array_equal(
+        y.numpy(), [[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]])
+
+
+def test_frame_1d_axis0_matches_reference_doc():
+    x = paddle.arange(8)
+    y = signal.frame(x, frame_length=4, hop_length=2, axis=0)
+    np.testing.assert_array_equal(
+        y.numpy(), [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+
+
+def test_frame_2d_and_3d_shapes():
+    x = paddle.arange(16).reshape([2, 8])
+    assert signal.frame(x, 4, 2, axis=-1).shape == [2, 4, 3]
+    x1 = paddle.arange(16).reshape([8, 2])
+    assert signal.frame(x1, 4, 2, axis=0).shape == [3, 4, 2]
+    x2 = paddle.arange(32).reshape([2, 2, 8])
+    assert signal.frame(x2, 4, 2, axis=-1).shape == [2, 2, 4, 3]
+
+
+def test_frame_validation():
+    x = paddle.arange(8)
+    with pytest.raises(ValueError):
+        signal.frame(x, 4, 2, axis=1)
+    with pytest.raises(ValueError):
+        signal.frame(x, 0, 2)
+    with pytest.raises(ValueError):
+        signal.frame(x, 4, 0)
+    with pytest.raises(ValueError):
+        signal.frame(x, 9, 1)
+
+
+def test_overlap_add_inverts_frame_on_hop_eq_length():
+    x = np.arange(24, dtype=np.float32).reshape(2, 12)
+    frames = signal.frame(paddle.to_tensor(x), 4, 4, axis=-1)
+    y = signal.overlap_add(frames, hop_length=4, axis=-1)
+    np.testing.assert_allclose(y.numpy(), x)
+
+
+def test_overlap_add_adds_overlaps():
+    # two frames of ones, hop 2, length 4 -> middle 2 samples count twice
+    frames = paddle.ones([4, 2])
+    y = signal.overlap_add(frames, hop_length=2, axis=-1)
+    np.testing.assert_allclose(y.numpy(), [1, 1, 2, 2, 1, 1])
+
+
+def test_overlap_add_axis0():
+    frames = paddle.ones([2, 4])  # (num_frames, frame_length)
+    y = signal.overlap_add(frames, hop_length=2, axis=0)
+    np.testing.assert_allclose(y.numpy(), [1, 1, 2, 2, 1, 1])
+
+
+def test_stft_matches_scipy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(2048).astype(np.float64)
+    n_fft, hop = 512, 128
+    win = sps.get_window("hann", n_fft)
+    y = signal.stft(paddle.to_tensor(x, dtype="float64"),
+                    n_fft=n_fft, hop_length=hop,
+                    window=paddle.to_tensor(win, dtype="float64"), center=True,
+                    pad_mode="reflect").numpy()
+    # scipy.signal.stft with boundary='even' == reflect-centered STFT
+    f, t, z = sps.stft(x, window=win, nperseg=n_fft, noverlap=n_fft - hop,
+                       boundary="even", padded=False,
+                       return_onesided=True)
+    # scipy normalises by win.sum(); undo it
+    np.testing.assert_allclose(y, z * win.sum(), rtol=1e-8, atol=1e-8)
+
+
+def test_stft_shapes_onesided_and_twosided():
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal((8, 4800)))
+    y1 = signal.stft(x, n_fft=512)
+    assert y1.shape == [8, 257, 38]
+    y2 = signal.stft(x, n_fft=512, onesided=False)
+    assert y2.shape == [8, 512, 38]
+    assert "complex" in str(y1.dtype)
+
+
+def test_stft_complex_input_requires_twosided():
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal(1024)
+        + 1j * np.random.default_rng(3).standard_normal(1024))
+    with pytest.raises(ValueError):
+        signal.stft(x, n_fft=256)
+    y = signal.stft(x, n_fft=256, onesided=False, center=False)
+    assert y.shape == [256, 13]
+
+
+def test_istft_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((3, 2048))
+    n_fft, hop = 512, 128
+    win = np.hanning(n_fft)
+    xt = paddle.to_tensor(x, dtype="float64")
+    win_t = paddle.to_tensor(win, dtype="float64")
+    y = signal.stft(xt, n_fft=n_fft, hop_length=hop, window=win_t)
+    back = signal.istft(y, n_fft=n_fft, hop_length=hop,
+                        window=win_t, length=2048)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-8, atol=1e-8)
+
+
+def test_istft_roundtrip_normalized_and_rect_window():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(1600)
+    y = signal.stft(paddle.to_tensor(x, dtype="float64"), n_fft=400,
+                    normalized=True)
+    back = signal.istft(y, n_fft=400, normalized=True, length=1600)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-8, atol=1e-8)
+
+
+def test_istft_nola_failure_raises():
+    # hop > win support for a window with zeros -> NOLA violated
+    win = np.zeros(512)
+    win[:8] = 1.0
+    y = signal.stft(paddle.to_tensor(np.random.default_rng(6)
+                                     .standard_normal(2048)),
+                    n_fft=512, hop_length=256,
+                    window=paddle.to_tensor(win))
+    with pytest.raises(ValueError, match="NOLA"):
+        signal.istft(y, n_fft=512, hop_length=256,
+                     window=paddle.to_tensor(win))
+
+
+def test_istft_validation():
+    y = signal.stft(paddle.to_tensor(
+        np.random.default_rng(7).standard_normal(1024)), n_fft=256)
+    with pytest.raises(ValueError):
+        signal.istft(y, n_fft=256, return_complex=True)  # needs twosided
+    with pytest.raises(TypeError):
+        signal.istft(paddle.ones([129, 5]), n_fft=256)   # real input
+    with pytest.raises(ValueError):
+        signal.istft(y, n_fft=512)  # fft_size mismatch
+
+
+def test_stft_grad_flows():
+    x = paddle.to_tensor(
+        np.random.default_rng(8).standard_normal(512).astype(np.float32))
+    x.stop_gradient = False
+    y = signal.stft(x, n_fft=128)
+    mag = (paddle.real(y) ** 2 + paddle.imag(y) ** 2).sum()
+    mag.backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()

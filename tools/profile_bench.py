@@ -29,6 +29,10 @@ def main():
     cfg = GPTConfig.gpt2_medium()
     cfg.hidden_dropout_prob = 0.0
     cfg.attention_dropout_prob = 0.0
+    cfg.scan_layers = os.getenv("PADDLE_TPU_BENCH_SCAN", "0") == "1"
+    cfg.scan_unroll = int(os.getenv("PADDLE_TPU_BENCH_SCAN_UNROLL",
+                                    cfg.num_hidden_layers))
+    cfg.scan_mode = os.getenv("PADDLE_TPU_BENCH_SCAN_MODE", "scan")
     batch, seq = 8, 1024
     model = GPTForCausalLM(cfg)
     paddle.amp.decorate(model, level="O2", dtype="bfloat16")
