@@ -767,9 +767,18 @@ class TrainStep:
                 and all(n in opt_state["slots"] for n in self._flat_names):
             slots = dict(opt_state["slots"])
             per = [slots.pop(n) for n in self._flat_names]
+            # mirror the EXPORT guard (state_dict passes non-param-shaped
+            # slot leaves through shared): only concatenate per-name
+            # leaves whose size matches the member's flat size — a future
+            # optimizer with scalar slots would otherwise produce a
+            # tree/shape mismatch against init_state (ADVICE r4)
             slots[_FLAT_KEY] = {
-                k: jnp.concatenate(
-                    [jnp.asarray(p[k]).reshape(-1) for p in per])
+                k: (jnp.concatenate(
+                        [jnp.asarray(p[k]).reshape(-1) for p in per])
+                    if all(hasattr(p[k], "shape")
+                           and int(jnp.asarray(p[k]).size) == sz
+                           for p, sz in zip(per, self._flat_sizes))
+                    else per[0][k])
                 for k in per[0]
                 if hasattr(per[0][k], "shape")}
             opt_state = {**opt_state, "slots": slots}

@@ -376,6 +376,17 @@ def convert_range_cond(i, stop, step):
     return ia > sa
 
 
+def _prior_or(lcls, name, thunk):
+    """Pre-binding for a desugared for-loop target: Python leaves a
+    PRIOR binding untouched when the loop runs zero trips, so keep it;
+    only fall back to the thunk (range start / first element) when the
+    name was never bound — the lax carry needs a typed initial value."""
+    v = lcls.get(name, UNDEFINED)
+    if v is not UNDEFINED:
+        return v
+    return _retval_init(thunk)
+
+
 def _retval_init(thunk):
     """Pre-loop evaluation of a loop-return expression, used to give the
     lax carry a typed initial value; unbound names fall back to UNDEFINED
@@ -678,9 +689,21 @@ def _desugar_for(node, ctx, uid, allow_return):
             rargs = [ast.Constant(0), rargs[0], ast.Constant(1)]
         elif len(rargs) == 2:
             rargs = rargs + [ast.Constant(1)]
+        lo_lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=_name_load(lo))
         pre += [_assign(lo, rargs[0]), _assign(hi, rargs[1]),
                 _assign(step, rargs[2]), _assign(cursor, _name_load(lo)),
-                _assign(tgt, _name_load(lo))]
+                # keep a PRIOR binding of the target for zero-trip loops
+                # (Python leaves it untouched); fall back to the range
+                # start only when the name was never bound
+                _assign(tgt, _call_rt(
+                    "_prior_or",
+                    ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                             args=[], keywords=[]),
+                    ast.Constant(tgt), lo_lam))]
         test = _call_rt("convert_range_cond", _name_load(cursor),
                         _name_load(hi), _name_load(step))
         bump = _assign(cursor, ast.BinOp(left=_name_load(cursor),
@@ -697,8 +720,14 @@ def _desugar_for(node, ctx, uid, allow_return):
         pre += [_assign(xs, node.iter),
                 _assign(n, _call_rt("convert_len", _name_load(xs))),
                 _assign(cursor, ast.Constant(0)),
-                # typed pre-binding of the target for the lax carry
-                _assign(tgt, _call_rt("_retval_init", zero_lam))]
+                # typed pre-binding of the target for the lax carry;
+                # a PRIOR binding survives zero-trip loops (Python
+                # semantics)
+                _assign(tgt, _call_rt(
+                    "_prior_or",
+                    ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                             args=[], keywords=[]),
+                    ast.Constant(tgt), zero_lam))]
         test = ast.Compare(left=_name_load(cursor), ops=[ast.Lt()],
                            comparators=[_name_load(n)])
         bump = _assign(cursor, ast.BinOp(left=_name_load(cursor),

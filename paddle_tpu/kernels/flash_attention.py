@@ -55,3 +55,12 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
     """q,k,v: (B, S, H, D) -> (B, S, H, D) — native layout, no transposes."""
     from .flash_attention_pallas import flash_attention_bshd_native
     return flash_attention_bshd_native(q, k, v, causal=causal, scale=scale)
+
+
+def flash_attention_bshd_with_lse(q, k, v, causal=False, scale=None,
+                                  interpret=False):
+    """(out, lse): lse is the base-e row logsumexp, (B, S, H) — the
+    differentiable building block of the ring-attention inner."""
+    from .flash_attention_pallas import \
+        flash_attention_bshd_with_lse as _impl
+    return _impl(q, k, v, causal=causal, scale=scale, interpret=interpret)

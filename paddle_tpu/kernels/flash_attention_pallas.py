@@ -71,6 +71,26 @@ _LOG2E = 1.4426950408889634
 _SEQ2 = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"))
 
+#: A/B flag: mask the causal band by multiplying p after exp2 (max over
+#: unmasked logits) instead of the -inf select before it
+_BAND_MUL = os.getenv("PADDLE_TPU_FLASH_BANDMUL", "0") == "1"
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the vma (varying-manual-axes) of ``like``
+    — pallas_call outputs inside a shard_map must declare how they vary
+    (the ring-attention inner runs these kernels under manual axes)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
 
 def _i32(v):
     return jnp.asarray(v, jnp.int32)
@@ -215,15 +235,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, hg,
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * \
                     jnp.float32(scale * _LOG2E)
+                band_mul = masked and _BAND_MUL
                 if masked:
                     col_ids = start[None, None] + \
                         jax.lax.broadcasted_iota(
                             jnp.int32, (block_q, block_k), 1)
-                    logits = jnp.where(col_ids <= row_ids, logits,
-                                       jnp.float32(_NEG_INF))
+                    vis = col_ids <= row_ids
+                    if not band_mul:
+                        logits = jnp.where(vis, logits,
+                                           jnp.float32(_NEG_INF))
+                # band_mul (PADDLE_TPU_FLASH_BANDMUL=1): run the max over
+                # UNMASKED logits (an over-estimate only shrinks p — lse
+                # stays exact) and zero the future columns AFTER the exp2
+                # with one multiply, replacing the -inf select
                 new_m = jnp.maximum(m, jnp.max(logits, axis=-1))
                 correction = jnp.exp2(m - new_m)
                 p = jnp.exp2(logits - new_m[:, None])
+                if band_mul:
+                    p = p * vis.astype(jnp.float32)
                 new_l = l * correction + jnp.sum(p, axis=-1)
                 new_acc = acc * correction[:, None] + jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -355,8 +384,8 @@ def _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
     nk = sk // block_k
     hgd = hg * d
     q_spec3 = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i: (bi, i, g))
-    lse_shape = jax.ShapeDtypeStruct((b, n_hg, hg, nq, block_q), jnp.float32)
-    out_shape = jax.ShapeDtypeStruct((b, s, hd), q3.dtype)
+    lse_shape = _sds((b, n_hg, hg, nq, block_q), jnp.float32, q3)
+    out_shape = _sds((b, s, hd), q3.dtype, q3)
     if _kv_fits_resident(sk, hgd):
         # fast path: whole K/V resident per cell, fori scan (measured
         # fastest at bench shapes)
@@ -608,7 +637,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
-                     block_k, hg, d, interpret):
+                     block_k, hg, d, interpret, dlse=None):
     """Two-kernel backward with O(block) VMEM — the long-sequence path
     (the merged kernel's full-sequence dq scratch caps it at ~8k tokens).
     Costs one extra recompute of the logits/dP matmuls per block pair."""
@@ -622,6 +651,8 @@ def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
     delta = jnp.sum(
         do3.reshape(b, s, h, d).astype(jnp.float32) *
         o3.reshape(b, s, h, d).astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.moveaxis(delta, -1, 1).reshape(b, n_hg, hg, nq, block_q)
 
     row_spec = pl.BlockSpec((1, 1, hg, nq, block_q),
@@ -637,7 +668,7 @@ def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
         in_specs=[q_spec_qout, kv_spec_qout, kv_spec_qout, q_spec_qout,
                   row_spec, row_spec],
         out_specs=q_spec_qout,
-        out_shape=jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
+        out_shape=_sds((b, s, hd), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block_q, hgd), jnp.float32)],
         compiler_params=_SEQ2,
         interpret=interpret,
@@ -654,8 +685,8 @@ def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
         in_specs=[q_spec_kout, kv_spec_kout, kv_spec_kout, q_spec_kout,
                   row_spec, row_spec],
         out_specs=[kv_spec_kout, kv_spec_kout],
-        out_shape=[jax.ShapeDtypeStruct((b, sk, hd), k3.dtype),
-                   jax.ShapeDtypeStruct((b, sk, hd), v3.dtype)],
+        out_shape=[_sds((b, sk, hd), k3.dtype, k3),
+                   _sds((b, sk, hd), v3.dtype, v3)],
         scratch_shapes=[pltpu.VMEM((block_k, hgd), jnp.float32),
                         pltpu.VMEM((block_k, hgd), jnp.float32)],
         compiler_params=_SEQ2,
@@ -665,7 +696,11 @@ def _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
-               hg, d, interpret=False):
+               hg, d, interpret=False, dlse=None):
+    # dlse: optional (b, s, h) f32 cotangent of a base-e lse OUTPUT
+    # (flash_attention_bshd_with_lse): it folds into the kernels as
+    # delta - dlse — dS_ij = P_ij (dP_ij - delta_i + dlse_i), so the
+    # existing kernels run unchanged
     with jax.enable_x64(False):
         s = max(q3.shape[1], k3.shape[1])
         if s * hg * d * 4 > _DQ_SCRATCH_BUDGET:
@@ -673,13 +708,13 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
             # blow VMEM — take the split two-kernel path
             return _flash_bwd_split(q3, k3, v3, o3, lse, do3, causal,
                                     scale, block_q, block_k, hg, d,
-                                    interpret)
+                                    interpret, dlse)
         return _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale,
-                                block_q, block_k, hg, d, interpret)
+                                block_q, block_k, hg, d, interpret, dlse)
 
 
 def _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
-                     block_k, hg, d, interpret):
+                     block_k, hg, d, interpret, dlse=None):
     b, s, hd = q3.shape
     sk = k3.shape[1]
     h = hd // d
@@ -692,6 +727,8 @@ def _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
     delta = jnp.sum(
         do3.reshape(b, s, h, d).astype(jnp.float32) *
         o3.reshape(b, s, h, d).astype(jnp.float32), axis=-1)       # (b,s,h)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.moveaxis(delta, -1, 1).reshape(b, n_hg, hg, nq, block_q)
 
     q_spec = pl.BlockSpec((1, block_q, hgd), lambda bi, g, i, j: (bi, j, g))
@@ -710,9 +747,9 @@ def _flash_bwd_inner(q3, k3, v3, o3, lse, do3, causal, scale, block_q,
             kv_spec,
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, s, hd), q3.dtype),
-            jax.ShapeDtypeStruct((b, sk, hd), k3.dtype),
-            jax.ShapeDtypeStruct((b, sk, hd), v3.dtype),
+            _sds((b, s, hd), q3.dtype, q3),
+            _sds((b, sk, hd), k3.dtype, k3),
+            _sds((b, sk, hd), v3.dtype, v3),
         ],
         scratch_shapes=[
             pltpu.VMEM((s, hgd), jnp.float32),
@@ -776,6 +813,100 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, hg_f, hg_b, d,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _prep_blocks(q, k, causal, block_q, block_k, what):
+    """Shared block/head-group policy of the public BSHD wrappers: shrink
+    to the largest divisible power-of-two blocks (>=128), cap block_k at
+    block_q under causal (the band split needs block_q %% block_k == 0),
+    and raise on ragged tails."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    while block_q > 128 and s % block_q:
+        block_q //= 2
+    while block_k > 128 and sk % block_k:
+        block_k //= 2
+    if causal and block_k > block_q:
+        block_k = block_q
+    if s % block_q or sk % block_k:
+        raise ValueError(
+            "%s: seq lengths (%d, %d) must be divisible by block sizes "
+            "(%d, %d) — ragged tails would be silently dropped; use the "
+            "XLA path (kernels.flash_attention.supported() gates this)"
+            % (what, s, sk, block_q, block_k))
+    return block_q, block_k
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9,
+                                                    10))
+def _flash_lse(q3, k3, v3, causal, scale, block_q, block_k, hg_f, hg_b, d,
+               interpret):
+    out, lse2 = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k,
+                           hg_f, d, interpret)
+    return out, lse2
+
+
+def _flash_lse_vjp_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg_f,
+                       hg_b, d, interpret):
+    out, lse2 = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k,
+                           hg_f, d, interpret)
+    return (out, lse2), (q3, k3, v3, out, lse2)
+
+
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, hg_f, hg_b, d,
+                       interpret, res, g):
+    q3, k3, v3, out, lse2 = res
+    dout, dlse2 = g
+    b, s, hd = q3.shape
+    h = hd // d
+    # unfold the (b, n_hg, hg, nq, bq) base-2 lse cotangent to (b, s, h)
+    # base-e: lse2 = lse_e * log2e, so dlse_e = dlse2 * log2e
+    dlse = jnp.moveaxis(
+        dlse2.reshape(b, h, s), 1, -1) * jnp.float32(_LOG2E)
+    lse = lse2
+    if hg_b != hg_f:
+        nq, bq = lse.shape[3], lse.shape[4]
+        lse = lse.reshape(b, h // hg_b, hg_b, nq, bq)
+    return _flash_bwd(q3, k3, v3, out, lse, dout, causal, scale, block_q,
+                      block_k, hg_b, d, interpret, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_bshd_with_lse(q, k, v, causal=False, scale=None,
+                                  block_q=DEFAULT_BLOCK_Q,
+                                  block_k=DEFAULT_BLOCK_K,
+                                  interpret=False):
+    """Like :func:`flash_attention_bshd_native` but ALSO returns the
+    row logsumexp in BASE E, shape (B, S, H) — and stays differentiable
+    when the caller consumes both (the lse cotangent folds into the
+    backward kernels as ``delta - dlse``).  This is the building block
+    the ring-attention inner needs (r4 verdict #3): per-shard
+    (out, lse) pairs combine exactly like global attention."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    hg_b = _pick_head_group(h, d, max(s, sk))
+    hg_f = _pick_fwd_head_group(h, d, max(s, sk), hg_b)
+    if hg_f != hg_b:
+        # one group for both directions: the lse OUTPUT layout must match
+        # what the backward consumes (the fwd/bwd regroup trick in
+        # _flash_vjp_bwd assumes lse is internal)
+        hg_f = hg_b
+    block_q, block_k = _prep_blocks(q, k, causal, block_q, block_k,
+                                    "flash_attention_with_lse")
+    q3 = q.reshape(b, s, h * d)
+    k3 = k.reshape(b, sk, h * d)
+    v3 = v.reshape(b, sk, h * d)
+    out, lse2 = _flash_lse(q3, k3, v3, causal, float(scale), block_q,
+                           block_k, hg_f, hg_b, d, interpret)
+    # (b, n_hg, hg, nq, bq) base-2 -> (b, s, h) base-e
+    lse = jnp.moveaxis(lse2.reshape(b, h, s), 1, -1) / jnp.float32(_LOG2E)
+    return out.reshape(b, s, h, d), lse
+
+
 def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
                                 block_q=DEFAULT_BLOCK_Q,
                                 block_k=DEFAULT_BLOCK_K, interpret=False):
@@ -786,23 +917,8 @@ def flash_attention_bshd_native(q, k, v, causal=False, scale=None,
         scale = 1.0 / (d ** 0.5)
     hg_b = _pick_head_group(h, d, max(s, sk))
     hg_f = _pick_fwd_head_group(h, d, max(s, sk), hg_b)
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
-    # shrink to the largest divisible block
-    while block_q > 128 and s % block_q:
-        block_q //= 2
-    while block_k > 128 and sk % block_k:
-        block_k //= 2
-    if causal and block_k > block_q:
-        # the causal scan splits the K loop at q-block granularity and
-        # needs block_q % block_k == 0 (both are powers of two)
-        block_k = block_q
-    if s % block_q or sk % block_k:
-        raise ValueError(
-            "flash_attention: seq lengths (%d, %d) must be divisible by "
-            "block sizes (%d, %d) — ragged tails would be silently dropped; "
-            "use the XLA path (kernels.flash_attention.supported() gates "
-            "this)" % (s, sk, block_q, block_k))
+    block_q, block_k = _prep_blocks(q, k, causal, block_q, block_k,
+                                    "flash_attention")
     q3 = q.reshape(b, s, h * d)
     k3 = k.reshape(b, sk, h * d)
     v3 = v.reshape(b, sk, h * d)

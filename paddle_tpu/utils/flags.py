@@ -66,12 +66,15 @@ define_flag("use_pallas_ce", False,
             "route hard-label cross_entropy through the fused Pallas "
             "softmax-CE kernel (XLA's streaming path measured faster on "
             "the 345M bench; opt-in escape hatch)")
-define_flag("use_pallas_lse", True,
+define_flag("use_pallas_lse", False,
             "compute hard-label CE's logsumexp with the one-pass streamed "
             "Pallas kernel (big tiles, online max/sum-exp2) instead of "
             "XLA's two streaming reductions — wall-clock WASH on the "
             "GPT-2 345M bench (within the +-500 tok/s tunnel noise, "
-            "~-1.5 ms/step in-device; PERF.md round-4)")
+            "~-1.5 ms/step in-device; PERF.md round-4).  Default OFF for "
+            "consistency with use_pallas_ce: a wash does not earn a "
+            "brand-new kernel the default single-device CE path "
+            "(ADVICE r4)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")

@@ -456,3 +456,19 @@ def test_jit_save_with_loop_break(tmp_path):
     paddle.jit.save(st, path, input_spec=[InputSpec([4], "float32", "x")])
     loaded = paddle.jit.load(path)
     np.testing.assert_allclose(np.asarray(loaded(x).numpy()), 8.0)
+
+
+def test_zero_trip_interrupt_loop_keeps_prior_target_binding():
+    # Python leaves a prior binding of the loop target untouched when the
+    # loop runs zero trips — the desugared form must too
+    def f(n):
+        x = 5
+        for x in range(n):
+            if x > 100:
+                break
+        return x
+
+    tf = transform_function(f)
+    assert getattr(tf, "__dy2static_transformed__", False)
+    assert tf(0) == 5 == f(0)
+    assert tf(3) == 2 == f(3)

@@ -175,3 +175,60 @@ def test_flash_bwd_split_long_seq_parity():
     for gm, gs, name in zip(g_merged, g_split, "qkv"):
         np.testing.assert_allclose(np.asarray(gs), np.asarray(gm),
                                    rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_flash_with_lse_matches_reference_and_grads():
+    """flash_attention_bshd_with_lse (r4 verdict #3): the (out, lse) pair
+    matches dense attention + logsumexp, and grads stay exact when the
+    LOSS CONSUMES BOTH outputs (the dlse term folds into the backward
+    kernels as delta - dlse)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention_pallas import \
+        flash_attention_bshd_with_lse
+    from paddle_tpu.nn.functional.attention import sdpa_reference_raw
+
+    b, s, h, d = 1, 256, 2, 64
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    for causal in (False, True):
+        out, lse = flash_attention_bshd_with_lse(q, k, v, causal=causal,
+                                                 interpret=True)
+        ref = sdpa_reference_raw(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        # reference lse
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -1e30)
+        ref_lse = jnp.moveaxis(jax.scipy.special.logsumexp(logits, -1),
+                               1, -1)                    # (b, s, h)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                                   rtol=1e-4, atol=1e-4)
+
+    # grads with an lse-consuming loss (the ring combine shape)
+    def loss_flash(q_, k_, v_):
+        out, lse = flash_attention_bshd_with_lse(q_, k_, v_, causal=True,
+                                                 interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q_, k_, v_):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q_, k_) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v_)
+        lse = jnp.moveaxis(jax.scipy.special.logsumexp(logits, -1), 1, -1)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
