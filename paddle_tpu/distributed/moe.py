@@ -325,3 +325,67 @@ def global_scatter(x, local_count, global_count, group=None):
 
 def global_gather(x, local_count, global_count, group=None):
     return global_scatter(x, local_count, global_count, group)
+
+
+def build_moe_pp_parity_demo(seed=33, E=2, d=8, h=16, n_stages=2, bps=1,
+                             m=4, mb=4, s=4):
+    """Tiny MoE-under-pp parity fixture shared by
+    tests/test_distributed.py::test_moe_under_pp_one_program and the
+    driver dryrun (§3c) — ONE model definition so the two parity checks
+    can never drift apart.
+
+    Returns (params, x, labels, embed_fn, block_fn, head_loss_fn, dims)
+    with dims = (n_stages, bps, m).  block_fn routes through moe_apply
+    over the 'ep' axis."""
+    import numpy as _np
+    rng = _np.random.RandomState(seed)
+    params = {
+        "embed": {"we": jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)},
+        "blocks": {
+            "gate": jnp.asarray(rng.randn(n_stages, bps, d, E) * 0.5,
+                                jnp.float32),
+            "w1": jnp.asarray(rng.randn(n_stages, bps, E, d, h) * 0.2,
+                              jnp.float32),
+            "b1": jnp.zeros((n_stages, bps, E, h), jnp.float32),
+            "w2": jnp.asarray(rng.randn(n_stages, bps, E, h, d) * 0.2,
+                              jnp.float32),
+            "b2": jnp.zeros((n_stages, bps, E, d), jnp.float32),
+        },
+        "head": {"wh": jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)},
+    }
+    x = jnp.asarray(rng.randn(m, mb, s, d), jnp.float32)
+    labels = jnp.asarray(rng.randn(m, mb, s, d), jnp.float32)
+
+    def embed_fn(ep_, xb):
+        return xb @ ep_["we"]
+
+    def block_fn(bp, hb):
+        moe_p = {k: bp[k] for k in ("gate", "w1", "b1", "w2", "b2")}
+        out, _aux = moe_apply(moe_p, hb, top_k=1, capacity_factor=2.0,
+                              axis=EP_AXIS)
+        return hb + out
+
+    def head_loss_fn(hp, ep_, hb, lbl):
+        return jnp.mean((hb @ hp["wh"] - lbl) ** 2)
+
+    return params, x, labels, embed_fn, block_fn, head_loss_fn, \
+        (n_stages, bps, m)
+
+
+def moe_pp_sequential_loss(params, x, labels, embed_fn, block_fn,
+                           head_loss_fn, dims, dp_axis="dp"):
+    """The non-pipelined reference computation for the parity fixture:
+    microbatch-mean loss of the sequential model, pmean'd over the data
+    axis (matching the pipeline's loss contract)."""
+    n_stages, bps, m = dims
+    total = 0.0
+    for i in range(m):
+        hb = embed_fn(params["embed"], x[i])
+        for st in range(n_stages):
+            for bi in range(bps):
+                bp = jax.tree_util.tree_map(lambda a: a[st, bi],
+                                            params["blocks"])
+                hb = block_fn(bp, hb)
+        total = total + head_loss_fn(params["head"], params["embed"], hb,
+                                     labels[i])
+    return jax.lax.pmean(total / m, dp_axis)

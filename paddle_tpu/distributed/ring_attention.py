@@ -351,12 +351,17 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
                 if s_py is not None:
                     # full local attention needs no lse — the plain flash
                     # custom_vjp serves directly (r4 verdict Weak #8)
+                    import os
+
                     from ..kernels.flash_attention_pallas import \
                         flash_attention_bshd_native
+                    interp = (os.getenv("PADDLE_TPU_RING_INNER",
+                                        "").lower()
+                              == "pallas_interpret")
                     out = flash_attention_bshd_native(
                         jnp.swapaxes(q_, 1, 2), jnp.swapaxes(k_, 1, 2),
                         jnp.swapaxes(v_, 1, 2), causal=causal,
-                        scale=s_py)
+                        scale=s_py, interpret=interp)
                     return jnp.swapaxes(out, 1, 2).astype(q_.dtype)
             # blockwise inner fallback: the gathered S_full axis is the
             # long one — never materialise (S_full, S_full) logits

@@ -1,6 +1,12 @@
 """paddle_tpu.incubate (reference surface: python/paddle/incubate/)."""
 from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv,
+                        segment_max, segment_mean, segment_min,
+                        segment_sum, softmax_mask_fuse,
+                        softmax_mask_fuse_upper_triangle)

@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed (reference namespace shim)."""
+from . import models  # noqa: F401

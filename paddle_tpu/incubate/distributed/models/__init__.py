@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed.models (reference namespace shim)."""
+from . import moe  # noqa: F401

@@ -1,0 +1,250 @@
+"""paddle.incubate graph/segment/fused-softmax operators (reference:
+python/paddle/incubate/__init__.py __all__ — segment_sum/mean/max/min
+(incubate/tensor/math.py over phi segment_pool), graph_send_recv
+(incubate/operators/graph_send_recv.py:22), graph_sample_neighbors
+(graph_sample_neighbors.py:23), graph_reindex (graph_reindex.py:23),
+graph_khop_sampler (graph_khop_sampler.py:23), softmax_mask_fuse(.py:23)
+and softmax_mask_fuse_upper_triangle).
+
+TPU-native notes:
+* segment reductions ride jax.ops.segment_* (differentiable, jit-safe when
+  the caller's ids are static-shaped; empty segments produce 0 like the
+  reference's phi kernels, not -inf).
+* the graph SAMPLING ops are host-side numpy: their output shapes are
+  data-dependent (number of sampled edges), which no static-shape compiler
+  can express — the reference runs them as eager CUDA ops in the input
+  pipeline, and here they run eagerly on host exactly where a DataLoader
+  would call them.
+* softmax_mask_fuse is a plain composition — XLA fuses the add into the
+  softmax, which is the entire point of the reference's hand-fused CUDA op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import wrap_op
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def _num_segments(segment_ids):
+    ids = _arr(segment_ids)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ids must be concrete (the output row count is "
+            "data-dependent); run segment ops eagerly or pad ids and pass "
+            "through jax.ops.segment_sum(num_segments=...) directly")
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _segment_pool(d, ids, n, pool):
+    """Shared pooling core (reference segment_pool semantics: empty
+    segments are 0, not +-inf; mean divides by the real count)."""
+    if pool == "sum":
+        return jax.ops.segment_sum(d, ids, n)
+    counts = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids, n)
+    shape = (-1,) + (1,) * (d.ndim - 1)
+    if pool == "mean":
+        s = jax.ops.segment_sum(d, ids, n)
+        return s / jnp.maximum(counts, 1).reshape(shape)
+    red = jax.ops.segment_max if pool == "max" else jax.ops.segment_min
+    out = red(d, ids, n)
+    empty = (counts == 0).reshape(shape)
+    return jnp.where(empty, jnp.zeros((), d.dtype), out)
+
+
+def _segment(pool):
+    @wrap_op
+    def op(data, segment_ids, name=None):
+        n = _num_segments(segment_ids)
+        ids = jnp.asarray(_arr(segment_ids), jnp.int32)
+        return _segment_pool(_arr(data), ids, n, pool)
+    op.__name__ = "segment_" + pool
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+@wrap_op
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Message passing gather-scatter (reference graph_send_recv.py:22):
+    gather ``x[src_index]``, segment-reduce onto ``dst_index`` rows of a
+    (out_size or x.shape[0])-row output."""
+    if pool_type not in ("sum", "mean", "max", "min"):
+        raise ValueError(
+            "pool_type should be `sum`, `mean`, `max` or `min`, but "
+            "received %s" % pool_type)
+    xa = _arr(x)
+    src = jnp.asarray(_arr(src_index), jnp.int32)
+    dst = jnp.asarray(_arr(dst_index), jnp.int32)
+    n = int(out_size) if out_size else xa.shape[0]
+    return _segment_pool(jnp.take(xa, src, axis=0), dst, n, pool_type)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    graph_sample_neighbors.py:23).  Host-side (data-dependent output
+    shape).  Returns (out_neighbors, out_count[, out_eids])."""
+    row_np = _np(row).reshape(-1)
+    colptr_np = _np(colptr).reshape(-1)
+    nodes = _np(input_nodes).reshape(-1)
+    eids_np = _np(eids).reshape(-1) if eids is not None else None
+    if return_eids and eids_np is None:
+        raise ValueError("`eids` should not be None if `return_eids` "
+                         "is True.")
+    # deterministic under paddle.seed: derive the numpy rng from the
+    # framework's PRNG stream (every other random op honors the seed)
+    from ..core import random as _rnd
+    seed = int(jax.random.randint(_rnd.next_key(), (), 0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    out_n, out_c, out_e = [], [], []
+    for node in nodes:
+        lo, hi = int(colptr_np[node]), int(colptr_np[node + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row_np[pick])
+        out_c.append(len(pick))
+        if eids_np is not None:
+            out_e.append(eids_np[pick])
+    neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_n) if out_n else np.zeros(0, row_np.dtype)))
+    count = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return neighbors, count, Tensor(jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros(0, row_np.dtype)))
+    return neighbors, count
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex sampled neighbors to local ids (reference
+    graph_reindex.py:23): out_nodes = [x, then unseen neighbors in
+    first-appearance order]; returns (reindex_src, reindex_dst,
+    out_nodes)."""
+    x_np = _np(x).reshape(-1)
+    nbr = _np(neighbors).reshape(-1)
+    cnt = _np(count).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for n in x_np:
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    src = np.empty(len(nbr), np.int64)
+    for i, n in enumerate(nbr):
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+        src[i] = mapping[n]
+    dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt)
+    dt = x_np.dtype
+    return (Tensor(jnp.asarray(src.astype(dt))),
+            Tensor(jnp.asarray(dst.astype(dt))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, dt))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + subgraph reindex (reference
+    graph_khop_sampler.py:23).  Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids])."""
+    if return_eids and sorted_eids is None:
+        raise ValueError("`sorted_eid` should not be None if return_eids "
+                         "is True.")
+    nodes = _np(input_nodes).reshape(-1)
+    frontier = nodes
+    all_centers, all_neighbors, all_counts, all_eids = [], [], [], []
+    for size in list(sample_sizes):
+        res = graph_sample_neighbors(row, colptr, Tensor(jnp.asarray(
+            frontier)), eids=sorted_eids, sample_size=int(size),
+            return_eids=return_eids)
+        nbr, cnt = _np(res[0]), _np(res[1])
+        all_centers.append(frontier)
+        all_neighbors.append(nbr)
+        all_counts.append(cnt)
+        if return_eids:
+            all_eids.append(_np(res[2]))
+        # next frontier: newly-discovered unique neighbors
+        seen = set(int(v) for f in all_centers for v in f)
+        frontier = np.asarray(
+            [v for v in dict.fromkeys(int(n) for n in nbr)
+             if v not in seen], dtype=nodes.dtype)
+        if frontier.size == 0:
+            frontier = np.zeros(0, nodes.dtype)
+    centers = np.concatenate(
+        [np.repeat(c, ct) for c, ct in zip(all_centers, all_counts)]) \
+        if all_centers else np.zeros(0, nodes.dtype)
+    neighbors = (np.concatenate(all_neighbors)
+                 if all_neighbors else np.zeros(0, nodes.dtype))
+    # reindex: inputs first, then neighbors/centers in appearance order
+    mapping = {}
+    out_nodes = []
+    rest = (np.concatenate([centers, neighbors]) if centers.size
+            else np.zeros(0, nodes.dtype))
+    for n in np.concatenate([nodes, rest]):
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    dt = nodes.dtype
+    edge_src = np.asarray([mapping[int(n)] for n in neighbors], dt)
+    edge_dst = np.asarray([mapping[int(c)] for c in centers], dt)
+    sample_index = np.asarray(out_nodes, dt)
+    reindex_nodes = np.asarray([mapping[int(n)] for n in nodes], dt)
+    outs = (Tensor(jnp.asarray(edge_src)), Tensor(jnp.asarray(edge_dst)),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(reindex_nodes)))
+    if return_eids:
+        eids = (np.concatenate(all_eids)
+                if all_eids else np.zeros(0, nodes.dtype))
+        return outs + (Tensor(jnp.asarray(eids)),)
+    return outs
+
+
+@wrap_op
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) — reference softmax_mask_fuse.py:23 (the CUDA
+    fusion is XLA's job here; stats in f32 like the rest of the stack)."""
+    xa, ma = _arr(x), _arr(mask)
+    out = jax.nn.softmax(xa.astype(jnp.float32) + ma.astype(jnp.float32),
+                         axis=-1)
+    return out.astype(xa.dtype)
+
+
+@wrap_op
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal attention
+    scores) — reference softmax_mask_fuse_upper_triangle."""
+    xa = _arr(x)
+    sq, sk = xa.shape[-2], xa.shape[-1]
+    visible = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    logits = jnp.where(visible, xa.astype(jnp.float32),
+                       jnp.float32(-1e30))
+    return jax.nn.softmax(logits, axis=-1).astype(xa.dtype)

@@ -14,6 +14,9 @@ from .random_ops import (bernoulli, binomial, gaussian, multinomial, normal,
                          poisson, rand, randint, randint_like, randn, randperm,
                          standard_normal, uniform)
 from .extras import *  # noqa: F401,F403
+# signal-processing ops (reference signal.py ops frame/overlap_add + the
+# stft/istft compositions) — re-exported so they carry schema entries
+from ..signal import frame, istft, overlap_add, stft  # noqa: F401
 from . import methods as _methods
 
 _methods.install()

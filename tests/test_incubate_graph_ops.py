@@ -1,0 +1,126 @@
+"""incubate segment/graph/fused-softmax ops (round 5; reference
+incubate/__init__.py __all__: segment_*, graph_send_recv,
+graph_sample_neighbors, graph_reindex, graph_khop_sampler,
+softmax_mask_fuse*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+
+
+def test_segment_reductions():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.],
+                                      [7., 8.]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 3], np.int32))
+    np.testing.assert_allclose(incubate.segment_sum(data, ids).numpy(),
+                               [[4, 6], [5, 6], [0, 0], [7, 8]])
+    np.testing.assert_allclose(incubate.segment_mean(data, ids).numpy(),
+                               [[2, 3], [5, 6], [0, 0], [7, 8]])
+    np.testing.assert_allclose(incubate.segment_max(data, ids).numpy(),
+                               [[3, 4], [5, 6], [0, 0], [7, 8]])
+    np.testing.assert_allclose(incubate.segment_min(data, ids).numpy(),
+                               [[1, 2], [5, 6], [0, 0], [7, 8]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    out = incubate.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+def test_graph_send_recv_doc_example():
+    # reference graph_send_recv.py docstring example
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = incubate.graph_send_recv(x, src, dst, pool_type="sum")
+    np.testing.assert_allclose(out.numpy(),
+                               [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    out_mean = incubate.graph_send_recv(x, src, dst, pool_type="mean")
+    np.testing.assert_allclose(out_mean.numpy(),
+                               [[0, 2, 3], [1, 4, 5], [1, 4, 5]])
+    out_sz = incubate.graph_send_recv(x, src, dst, pool_type="max",
+                                      out_size=2)
+    assert out_sz.shape == [2, 3]
+    with pytest.raises(ValueError):
+        incubate.graph_send_recv(x, src, dst, pool_type="prod")
+
+
+def test_graph_sample_neighbors_deterministic_when_all():
+    # CSC graph from the reference khop docstring
+    row = paddle.to_tensor(np.array(
+        [3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], np.int64))
+    colptr = paddle.to_tensor(np.array(
+        [0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 8, 1, 2], np.int64))
+    nbr, cnt = incubate.graph_sample_neighbors(row, colptr, nodes,
+                                               sample_size=-1)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 2, 2, 1])
+    np.testing.assert_array_equal(nbr.numpy(), [3, 7, 9, 7, 0, 9, 1])
+    # bounded sampling returns at most sample_size per node
+    nbr2, cnt2 = incubate.graph_sample_neighbors(row, colptr, nodes,
+                                                 sample_size=1)
+    assert (cnt2.numpy() <= 1).all()
+    with pytest.raises(ValueError):
+        incubate.graph_sample_neighbors(row, colptr, nodes,
+                                        return_eids=True)
+
+
+def test_graph_reindex_doc_example():
+    x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+    src, dst, out_nodes = incubate.graph_reindex(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(out_nodes.numpy(),
+                                  [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_graph_khop_sampler_shapes_and_reindex():
+    row = paddle.to_tensor(np.array(
+        [3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], np.int64))
+    colptr = paddle.to_tensor(np.array(
+        [0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 8, 1, 2], np.int64))
+    src, dst, sample_index, reindex_nodes = incubate.graph_khop_sampler(
+        row, colptr, nodes, [2, 2])
+    # input nodes occupy the first slots of the sample index
+    np.testing.assert_array_equal(sample_index.numpy()[:4], [0, 8, 1, 2])
+    np.testing.assert_array_equal(reindex_nodes.numpy(), [0, 1, 2, 3])
+    assert src.shape == dst.shape
+    # every edge endpoint maps back to a real node id
+    samp = sample_index.numpy()
+    orig_dst = samp[dst.numpy()]
+    assert set(orig_dst).issubset(set(samp.tolist()))
+    with pytest.raises(ValueError):
+        incubate.graph_khop_sampler(row, colptr, nodes, [2],
+                                    return_eids=True)
+
+
+def test_softmax_mask_fuse():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    mask = np.where(rng.rand(2, 1, 8, 8) < 0.3, -1e30, 0.0).astype(
+        np.float32)
+    mask[..., np.arange(8), np.arange(8)] = 0.0
+    out = incubate.softmax_mask_fuse(paddle.to_tensor(x),
+                                     paddle.to_tensor(mask)).numpy()
+    ref = np.exp(x + mask - (x + mask).max(-1, keepdims=True))
+    ref = ref / ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    out = incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(x)).numpy()
+    # future positions get zero probability; rows sum to 1
+    assert np.allclose(np.triu(out[0, 0], k=1), 0.0)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
