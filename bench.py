@@ -55,8 +55,12 @@ def main():
     if on_tpu:
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     crit = GPTPretrainingCriterion()
+    import os as _os
+    # opt-in reduced-precision optimizer state A/B (PERF.md round 5)
+    mdt = _os.getenv("PADDLE_TPU_BENCH_MOMENT_DTYPE") or None
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
-                                 learning_rate=1e-4, weight_decay=0.01)
+                                 learning_rate=1e-4, weight_decay=0.01,
+                                 moment_dtype=mdt)
     step = TrainStep(model, lambda logits, labels: crit(logits, labels), opt)
 
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)

@@ -114,11 +114,7 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     if return_eids and eids_np is None:
         raise ValueError("`eids` should not be None if `return_eids` "
                          "is True.")
-    # deterministic under paddle.seed: derive the numpy rng from the
-    # framework's PRNG stream (every other random op honors the seed)
-    from ..core import random as _rnd
-    seed = int(jax.random.randint(_rnd.next_key(), (), 0, 2**31 - 1))
-    rng = np.random.default_rng(seed)
+    rng = None
     out_n, out_c, out_e = [], [], []
     for node in nodes:
         lo, hi = int(colptr_np[node]), int(colptr_np[node + 1])
@@ -126,6 +122,15 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
         if sample_size < 0 or deg <= sample_size:
             pick = np.arange(lo, hi)
         else:
+            if rng is None:
+                # deterministic under paddle.seed (derived from the
+                # framework PRNG stream) — drawn LAZILY so a fully
+                # deterministic call (sample_size=-1 / small degrees)
+                # does not advance the global key stream
+                from ..core import random as _rnd
+                seed = int(jax.random.randint(_rnd.next_key(), (), 0,
+                                              2**31 - 1))
+                rng = np.random.default_rng(seed)
             pick = lo + rng.choice(deg, size=sample_size, replace=False)
         out_n.append(row_np[pick])
         out_c.append(len(pick))
@@ -140,6 +145,21 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     return neighbors, count
 
 
+def _first_appearance_index(*id_arrays):
+    """Shared reindex core: one {orig id -> local id} mapping built in
+    first-appearance order across the given arrays, plus the ordered
+    unique id list."""
+    mapping = {}
+    out_nodes = []
+    for arr in id_arrays:
+        for n in arr:
+            n = int(n)
+            if n not in mapping:
+                mapping[n] = len(out_nodes)
+                out_nodes.append(n)
+    return mapping, out_nodes
+
+
 def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
                   flag_buffer_hashtable=False, name=None):
     """Reindex sampled neighbors to local ids (reference
@@ -149,20 +169,8 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
     x_np = _np(x).reshape(-1)
     nbr = _np(neighbors).reshape(-1)
     cnt = _np(count).reshape(-1)
-    mapping = {}
-    out_nodes = []
-    for n in x_np:
-        n = int(n)
-        if n not in mapping:
-            mapping[n] = len(out_nodes)
-            out_nodes.append(n)
-    src = np.empty(len(nbr), np.int64)
-    for i, n in enumerate(nbr):
-        n = int(n)
-        if n not in mapping:
-            mapping[n] = len(out_nodes)
-            out_nodes.append(n)
-        src[i] = mapping[n]
+    mapping, out_nodes = _first_appearance_index(x_np, nbr)
+    src = np.asarray([mapping[int(n)] for n in nbr], np.int64)
     dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt)
     dt = x_np.dtype
     return (Tensor(jnp.asarray(src.astype(dt))),
@@ -204,15 +212,9 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     neighbors = (np.concatenate(all_neighbors)
                  if all_neighbors else np.zeros(0, nodes.dtype))
     # reindex: inputs first, then neighbors/centers in appearance order
-    mapping = {}
-    out_nodes = []
     rest = (np.concatenate([centers, neighbors]) if centers.size
             else np.zeros(0, nodes.dtype))
-    for n in np.concatenate([nodes, rest]):
-        n = int(n)
-        if n not in mapping:
-            mapping[n] = len(out_nodes)
-            out_nodes.append(n)
+    mapping, out_nodes = _first_appearance_index(nodes, rest)
     dt = nodes.dtype
     edge_src = np.asarray([mapping[int(n)] for n in neighbors], dt)
     edge_dst = np.asarray([mapping[int(c)] for c in centers], dt)

@@ -48,6 +48,9 @@ class Optimizer:
         # None = auto: on whenever a param is bf16/fp16 — without a master
         # copy, lr~1e-4 updates on O2 bf16 weights vanish below the bf16 ULP.
         self._multi_precision = multi_precision
+        #: optimizers that support reduced-precision STATE set this (e.g.
+        #: Adam(moment_dtype='bfloat16')); None = keep slots f32
+        self._moment_dtype = None
 
     def _wants_master(self, p) -> bool:
         if self._multi_precision is False:
@@ -57,13 +60,15 @@ class Optimizer:
     def _init_slots(self, p):
         slots = self.init_one(p)
         if self._wants_master(p):
-            # all slots f32 from step 0: the master-path update returns f32
-            # slots, and a dtype flip between steps would silently retrace
-            # the compiled train step and break buffer donation
-            slots = {k: v.astype(jnp.float32)
-                     if hasattr(v, "dtype") and jnp.issubdtype(
-                         v.dtype, jnp.floating) else v
-                     for k, v in slots.items()}
+            if self._moment_dtype is None:
+                # all slots f32 from step 0: the master-path update returns
+                # f32 slots, and a dtype flip between steps would silently
+                # retrace the compiled train step and break buffer donation
+                slots = {k: v.astype(jnp.float32)
+                         if hasattr(v, "dtype") and jnp.issubdtype(
+                             v.dtype, jnp.floating) else v
+                         for k, v in slots.items()}
+            # reduced-precision moments keep init_one's intentional dtypes
             slots["master"] = p.astype(jnp.float32)
         return slots
 

@@ -69,6 +69,14 @@ def test_graph_sample_neighbors_deterministic_when_all():
     with pytest.raises(ValueError):
         incubate.graph_sample_neighbors(row, colptr, nodes,
                                         return_eids=True)
+    # a fully-deterministic call (sample_size=-1) must NOT advance the
+    # global PRNG stream (the key is drawn lazily, only when sampling)
+    paddle.seed(123)
+    a = paddle.randn([4]).numpy()
+    paddle.seed(123)
+    incubate.graph_sample_neighbors(row, colptr, nodes, sample_size=-1)
+    b = paddle.randn([4]).numpy()
+    np.testing.assert_array_equal(a, b)
 
 
 def test_graph_reindex_doc_example():

@@ -166,6 +166,39 @@ def _np_fill_diag(x, value):
     return y
 
 
+# -- signal ops (round 5; scipy-level value tests live in
+# tests/test_signal.py — these specs cover fwd/grad/bf16 in the harness) --
+
+def _frame_ref(x, frame_length=4, hop_length=2, axis=-1):
+    n = 1 + (x.shape[-1] - frame_length) // hop_length
+    idx = (np.arange(frame_length)[:, None]
+           + hop_length * np.arange(n)[None, :])
+    return x[..., idx]
+
+
+def _overlap_add_ref(x, hop_length=2, axis=-1):
+    fl, n = x.shape[-2], x.shape[-1]
+    out = np.zeros(x.shape[:-2] + ((n - 1) * hop_length + fl,), x.dtype)
+    for i in range(n):
+        out[..., i * hop_length:i * hop_length + fl] += x[..., :, i]
+    return out
+
+
+TAIL_SPECS += [
+    Spec("frame",
+         lambda: ([np.random.rand(3, 16).astype(np.float32)],
+                  dict(frame_length=4, hop_length=2)),
+         _frame_ref, fn=lambda x, **kw: paddle.signal.frame(x, **kw),
+         grad=(0,)),
+    Spec("overlap_add",
+         lambda: ([np.random.rand(3, 4, 7).astype(np.float32)],
+                  dict(hop_length=2)),
+         _overlap_add_ref,
+         fn=lambda x, **kw: paddle.signal.overlap_add(x, **kw),
+         grad=(0,)),
+]
+
+
 @pytest.mark.parametrize("spec", TAIL_SPECS, ids=lambda s: s.name)
 def test_tail_forward_parity_f32(spec):
     if spec.ref is None:
@@ -451,38 +484,6 @@ _DIRECT_COVERED = {
                                  # grads in tests/test_signal.py
 }
 
-
-# -- signal ops (round 5; scipy-level value tests live in
-# tests/test_signal.py — these specs cover fwd/grad/bf16 in the harness) --
-
-def _frame_ref(x, frame_length=4, hop_length=2, axis=-1):
-    n = 1 + (x.shape[-1] - frame_length) // hop_length
-    idx = (np.arange(frame_length)[:, None]
-           + hop_length * np.arange(n)[None, :])
-    return x[..., idx]
-
-
-def _overlap_add_ref(x, hop_length=2, axis=-1):
-    fl, n = x.shape[-2], x.shape[-1]
-    out = np.zeros(x.shape[:-2] + ((n - 1) * hop_length + fl,), x.dtype)
-    for i in range(n):
-        out[..., i * hop_length:i * hop_length + fl] += x[..., :, i]
-    return out
-
-
-TAIL_SPECS += [
-    Spec("frame",
-         lambda: ([np.random.rand(3, 16).astype(np.float32)],
-                  dict(frame_length=4, hop_length=2)),
-         _frame_ref, fn=lambda x, **kw: paddle.signal.frame(x, **kw),
-         grad=(0,)),
-    Spec("overlap_add",
-         lambda: ([np.random.rand(3, 4, 7).astype(np.float32)],
-                  dict(hop_length=2)),
-         _overlap_add_ref,
-         fn=lambda x, **kw: paddle.signal.overlap_add(x, **kw),
-         grad=(0,)),
-]
 
 #: ops intentionally without a suite spec — must stay EMPTY unless a
 #: documented reason lands here; anything else failing the equality gate
