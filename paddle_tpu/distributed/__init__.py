@@ -75,3 +75,5 @@ def __getattr__(name):
         from . import launch_main
         return launch_main
     raise AttributeError(name)
+
+from . import utils  # noqa: E402,F401

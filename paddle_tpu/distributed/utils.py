@@ -1,0 +1,7 @@
+"""paddle.distributed.utils (reference: python/paddle/distributed/utils.py
+— global_scatter:57 / global_gather:179 plus launcher helpers).  The MoE
+exchange primitives live in distributed.moe; re-exported here at the
+reference's import path."""
+from .moe import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["global_scatter", "global_gather"]

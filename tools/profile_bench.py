@@ -37,8 +37,10 @@ def main():
     model = GPTForCausalLM(cfg)
     paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     crit = GPTPretrainingCriterion()
-    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
-                                 learning_rate=1e-4, weight_decay=0.01)
+    opt = paddle.optimizer.AdamW(
+        parameters=model.parameters(), learning_rate=1e-4,
+        weight_decay=0.01,
+        moment_dtype=os.getenv("PADDLE_TPU_BENCH_MOMENT_DTYPE") or None)
     step = TrainStep(model, lambda lg, lb: crit(lg, lb), opt)
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     x = jnp.asarray(ids)
