@@ -5,6 +5,8 @@ from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import operators  # noqa: F401
+from . import tensor  # noqa: F401
 from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                         graph_sample_neighbors, graph_send_recv,
                         segment_max, segment_mean, segment_min,

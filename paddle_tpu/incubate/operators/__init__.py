@@ -1,0 +1,11 @@
+"""paddle.incubate.operators (reference module path:
+python/paddle/incubate/operators/__init__.py) — the graph/fused-softmax
+operators re-exported from incubate.graph_ops."""
+from ..graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                         graph_sample_neighbors, graph_send_recv,
+                         softmax_mask_fuse,
+                         softmax_mask_fuse_upper_triangle)
+
+__all__ = ["graph_send_recv", "graph_khop_sampler",
+           "graph_sample_neighbors", "graph_reindex",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

@@ -1,0 +1,6 @@
+"""paddle.incubate.tensor.math (reference path) — segment reductions over
+jax.ops.segment_* (implementations in incubate.graph_ops)."""
+from ..graph_ops import (segment_max, segment_mean, segment_min,  # noqa
+                         segment_sum)
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

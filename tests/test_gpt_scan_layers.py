@@ -165,3 +165,24 @@ def test_amp_o2_keeps_stacked_ln_fp32():
     sd = model.state_dict()
     assert str(sd["gpt.h_stack.ln1_w"].dtype).endswith("float32")
     assert str(sd["gpt.h_stack.qkv_w"].dtype).endswith("bfloat16")
+
+
+def test_scan_model_jit_save_load_parity(tmp_path):
+    """The stacked-param model exports through the same StableHLO artifact
+    path as the per-layer one (jit.save -> load without the class)."""
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = GPTForCausalLM(_tiny(True))
+    m.eval()
+    path = str(tmp_path / "scan_model")
+    jit.save(m, path, input_spec=[InputSpec([1, 8], "int32", "ids")])
+    loaded = jit.load(path)
+    ids = paddle.to_tensor(
+        np.random.default_rng(6).integers(0, 512, (1, 8)).astype("int32"))
+    got = loaded(ids)
+    g = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(np.asarray(g.numpy()),
+                               np.asarray(m(ids).numpy()),
+                               rtol=1e-4, atol=1e-5)
