@@ -428,7 +428,8 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
     def _es_shardable(p):
         # row-split only the big leaves (the wte); small ones stay whole
         return (es_axis is not None and p.ndim >= 2
-                and p.shape[0] % es_n == 0 and p.size >= (1 << 20))
+                and p.shape[0] % es_n == 0
+                and p.size >= _EMBED_SHARD_MIN_ELEMS)
 
     def masked_add_embed(acc_tree, d_tree, keep):
         def one(a, d):
@@ -568,6 +569,12 @@ def spmd_pipeline_1f1b_hetero(embed_fn: Callable, block_fn: Callable,
 #: warn when the hetero schedule would replicate more f32 embedding grad
 #: accumulator than this per pipeline stage (VERDICT r3 Weak #3)
 _EMBED_REPLICATION_WARN_BYTES = 512 * 1024 * 1024
+
+#: embed-grad leaves at or above this element count accumulate ROW-SHARDED
+#: (embed_grad_shard): only the big arrays (the wte) are worth the
+#: per-tick psum_scatter; small leaves stay whole.  Module-level so tests
+#: can lower it to force the sharded path on tiny models.
+_EMBED_SHARD_MIN_ELEMS = 1 << 20
 
 
 class _CompiledPipelineStep:

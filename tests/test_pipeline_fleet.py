@@ -440,3 +440,66 @@ def test_compiled_pipeline_warns_on_huge_embedding(monkeypatch):
                    for x in w)              # over threshold: warns
     finally:
         mesh_mod.init_mesh({"dp": 1})
+
+
+def test_embed_grad_shard_exact_parity(monkeypatch):
+    """The row-sharded embedding-grad accumulator (r4 verdict #10): with
+    the size threshold lowered so the tiny test embedding qualifies, the
+    per-tick psum_scatter + final all_gather path must reproduce the
+    UNsharded accumulator's loss and embed grads exactly.  (At the default
+    1M-element threshold only production-size vocabs shard, so this test
+    is the only place the collective path executes.)"""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed import pipeline as pipe_mod
+    from paddle_tpu.distributed.pipeline import spmd_pipeline_1f1b_hetero
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+
+    n_st, bps, m, mb, d = 2, 1, 4, 4, 8
+    rng = np.random.RandomState(5)
+    params = {
+        "embed": {"we": np.asarray(rng.randn(d, d) * 0.3, np.float32)},
+        "blocks": {"w": np.asarray(rng.randn(n_st, bps, d, d) * 0.3,
+                                   np.float32)},
+        "head": {"wh": np.asarray(rng.randn(d, d) * 0.3, np.float32)},
+    }
+    params = {k: {kk: jnp.asarray(vv) for kk, vv in v.items()}
+              for k, v in params.items()}
+    x = jnp.asarray(rng.randn(m, mb, d), jnp.float32)
+    labels = jnp.asarray(rng.randn(m, mb, d), jnp.float32)
+
+    def embed_fn(ep, xb):
+        return xb @ ep["we"]
+
+    def block_fn(bp, h):
+        return jnp.tanh(h @ bp["w"]) + h
+
+    def head_loss_fn(hp, ep, h, lbl):
+        return jnp.mean((h @ hp["wh"] - lbl) ** 2)
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("pp", "dp"))
+    pspec = {"embed": {"we": P()}, "blocks": {"w": P("pp")},
+             "head": {"wh": P()}}
+
+    def run(es):
+        pipe = jax.jit(shard_map(
+            lambda p, x_, l_: spmd_pipeline_1f1b_hetero(
+                embed_fn, block_fn, head_loss_fn, p, x_, l_, n_st, bps,
+                m, batch_axes=("dp",), embed_grad_shard=es),
+            mesh=mesh,
+            in_specs=(pspec, P(None, "dp"), P(None, "dp")),
+            out_specs=(P(), pspec), check_vma=False))
+        loss, grads = pipe(params, x, labels)
+        return float(loss), np.asarray(grads["embed"]["we"])
+
+    loss_ref, g_ref = run(None)
+    monkeypatch.setattr(pipe_mod, "_EMBED_SHARD_MIN_ELEMS", 1)
+    loss_sh, g_sh = run(("dp", 2))
+    np.testing.assert_allclose(loss_sh, loss_ref, rtol=1e-6)
+    np.testing.assert_allclose(g_sh, g_ref, rtol=1e-5, atol=1e-6)
