@@ -1,0 +1,46 @@
+"""paddle_tpu.analysis — tpu-lint, the static-analysis pass framework.
+
+The reference snapshot polices its 300k-LoC kernel surface with compiler
+passes over the ProgramDesc and a generated op schema; this package is
+the equivalent gate for the TPU build's Python source: AST passes that
+enforce the repo's correctness/perf invariants on every PR *without
+compiling a model*.
+
+Rule catalogue (details per pass module, workflow in ANALYSIS.md):
+
+=======  ==================  ==============================================
+rule     pass                invariant
+=======  ==================  ==============================================
+TPU101   host_sync           no device→host sync reachable from jitted code
+TPU201   x64                 no f64/s64 widening under the global x64 mode
+TPU301   collectives         collective axis names match declared mesh axes
+TPU401   schema_drift        ops_schema.yaml matches the live op surface
+=======  ==================  ==============================================
+
+Programmatic use::
+
+    from paddle_tpu.analysis import Analyzer
+    report = Analyzer(root=repo_root).run(["paddle_tpu"])
+    assert report.ok, "\\n".join(f.format() for f in report.findings)
+
+CLI: ``python -m paddle_tpu.analysis [paths] --strict``.
+"""
+from .core import (Analyzer, FileContext, Finding, LintPass, ProjectPass,
+                   Report, ScopedVisitor)
+from .baseline import Baseline, BaselineEntry, BaselineFormatError
+from .host_sync import HostSyncPass
+from .x64 import S64_COMPUTE_OPS, X64WideningPass
+from .collectives import CollectiveAxisPass
+from .schema_drift import SchemaDriftPass
+
+#: default pass set, in rule-id order.
+ALL_PASSES = [HostSyncPass, X64WideningPass, CollectiveAxisPass,
+              SchemaDriftPass]
+
+RULES = {p.rule: p for p in ALL_PASSES}
+
+__all__ = ["Analyzer", "FileContext", "Finding", "LintPass", "ProjectPass",
+           "Report", "ScopedVisitor", "Baseline", "BaselineEntry",
+           "BaselineFormatError", "HostSyncPass", "X64WideningPass",
+           "CollectiveAxisPass", "SchemaDriftPass", "ALL_PASSES", "RULES",
+           "S64_COMPUTE_OPS"]

@@ -1,0 +1,81 @@
+"""CLI for tpu-lint: ``python -m paddle_tpu.analysis [paths] [--strict]``.
+
+Exit codes: 0 clean (or findings without --strict), 1 findings under
+--strict, 2 operational error (unparsable file, bad baseline).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import ALL_PASSES, RULES, Analyzer
+from .baseline import BaselineFormatError
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tpu-lint — static analysis for the paddle_tpu tree")
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                    help="files/directories to analyze (default: paddle_tpu)")
+    ap.add_argument("--root", default=os.getcwd(),
+                    help="repo root for relative paths + baseline "
+                         "(default: cwd)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any unsuppressed finding remains")
+    ap.add_argument("--baseline", default="auto",
+                    help="baseline file (default: "
+                         "<root>/tools/tpu_lint_baseline.txt if present); "
+                         "'none' disables")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run "
+                         f"(available: {', '.join(sorted(RULES))})")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="findings only, no summary")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, cls in sorted(RULES.items()):
+            print(f"{rule}  {cls.name:<18} {cls.description}")
+        return 0
+
+    passes = ALL_PASSES
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        passes = [RULES[r] for r in sorted(wanted)]
+
+    baseline = None if args.baseline == "none" else args.baseline
+    try:
+        analyzer = Analyzer(root=args.root, passes=passes,
+                            baseline_path=baseline)
+        report = analyzer.run(args.paths)
+    except (BaselineFormatError, OSError) as e:
+        print(f"tpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    for f in report.findings:
+        print(f.format())
+    for s in report.stale_baseline:
+        print(f"warning: stale baseline entry — {s}", file=sys.stderr)
+    for e in report.errors:
+        print(f"error: {e}", file=sys.stderr)
+    if not args.quiet:
+        print(f"tpu-lint: {report.summary()}", file=sys.stderr)
+
+    if report.errors:
+        return 2
+    if report.findings and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Baseline suppression file for tpu-lint.
+
+Accepted debt is recorded in ``tools/tpu_lint_baseline.txt`` so the strict
+CI run stays green without hiding the rule.  One entry per line::
+
+    RULE  path[::symbol]  # mandatory one-line reason
+
+* ``path`` is repo-relative (posix).
+* ``symbol`` is the enclosing def/class qualname as printed by the
+  finding (``ReduceOnPlateau.step``); ``*`` (or omitting ``::symbol``)
+  baselines the whole file for that rule — used for modules where the
+  pattern is the *point* (e.g. paddle's int64 index-output parity).
+* The reason is required: an entry without ``#`` is a parse error, so
+  nobody can baseline a finding silently.
+
+Entries are matched by (rule, path, symbol) — never by line number, so
+unrelated edits to a file do not invalidate its baseline.  Entries that
+match nothing are reported as stale so the file shrinks over time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+__all__ = ["BaselineEntry", "Baseline", "BaselineFormatError"]
+
+
+class BaselineFormatError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    symbol: str          # "*" = whole file
+    reason: str
+    lineno: int = 0
+    used: bool = False
+
+    def matches(self, finding) -> bool:
+        if finding.rule != self.rule or finding.path != self.path:
+            return False
+        return self.symbol == "*" or finding.symbol == self.symbol or \
+            finding.symbol.startswith(self.symbol + ".")
+
+
+class Baseline:
+    def __init__(self, entries: List[BaselineEntry]):
+        self.entries = entries
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        entries: List[BaselineEntry] = []
+        if not path:
+            return cls(entries)
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                stripped = line.strip()
+                if not stripped or stripped.startswith("#"):
+                    continue
+                if "#" not in stripped:
+                    raise BaselineFormatError(
+                        f"{path}:{lineno}: baseline entry needs a "
+                        f"'# reason' comment: {stripped!r}")
+                spec, reason = stripped.split("#", 1)
+                parts = spec.split()
+                if len(parts) != 2:
+                    raise BaselineFormatError(
+                        f"{path}:{lineno}: expected 'RULE path[::symbol]"
+                        f"  # reason', got: {stripped!r}")
+                rule, target = parts
+                if "::" in target:
+                    fpath, symbol = target.split("::", 1)
+                else:
+                    fpath, symbol = target, "*"
+                if not reason.strip():
+                    raise BaselineFormatError(
+                        f"{path}:{lineno}: empty reason for {rule} {target}")
+                entries.append(BaselineEntry(rule=rule, path=fpath,
+                                             symbol=symbol or "*",
+                                             reason=reason.strip(),
+                                             lineno=lineno))
+        return cls(entries)
+
+    def matches(self, finding) -> bool:
+        hit = False
+        for e in self.entries:
+            if e.matches(finding):
+                e.used = True
+                hit = True
+        return hit
+
+    def stale(self) -> List[str]:
+        return [f"line {e.lineno}: {e.rule} {e.path}::{e.symbol} "
+                f"({e.reason})" for e in self.entries if not e.used]

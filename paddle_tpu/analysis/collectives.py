@@ -1,0 +1,134 @@
+"""TPU301 — collective axis-name checker.
+
+On TPU a communicator is a *mesh axis name* (paddle_tpu/distributed/mesh.py
+AXIS_ORDER — the NCCL ring-id registry's analogue).  A ``lax.psum`` over an
+axis name that no mesh declares fails only at trace time, inside a
+shard_map, usually several call layers away from the typo.  This pass
+cross-references the two statically:
+
+* **declarations** — collected in :meth:`prepare` from *every* analyzed
+  file: string/tuple/dict-value assignments to names matching ``AXIS``
+  (``AXIS_ORDER``, ``EP_AXIS``, ``AXIS_MAP`` values) plus the
+  ``_default_axis`` registry default.
+* **uses** — ``jax.lax`` collective calls (:data:`COLLECTIVES`) whose
+  axis argument is a string literal, a tuple of literals, or a name that
+  resolves to a module-level string constant.
+
+A literal axis that matches no declaration anywhere in scope is flagged.
+Variables that cannot be resolved statically are skipped (most library
+code threads ``axis_name`` parameters — those are the *caller's*
+declaration problem).  If no declarations exist in scope at all the pass
+stays silent rather than flagging every axis in a partial run.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from .core import FileContext, Finding, LintPass, ScopedVisitor
+
+RULE = "TPU301"
+
+#: collective name -> positional index of its axis-name argument.
+COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pbroadcast": 1, "pvary": 1, "pcast": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_AXIS_NAME_RE = re.compile(r"(^|_)axis", re.IGNORECASE)
+
+
+def _collect_strings(node) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            out.extend(_collect_strings(e))
+        return out
+    if isinstance(node, ast.Dict):
+        out = []
+        for v in node.values:
+            out.extend(_collect_strings(v))
+        return out
+    return []
+
+
+class CollectiveAxisPass(LintPass):
+    rule = RULE
+    name = "collective-axis"
+    description = ("lax collective calls whose literal axis_name matches "
+                   "no declared mesh axis")
+
+    def __init__(self):
+        self.declared: Set[str] = set()
+
+    def prepare(self, contexts: Sequence[FileContext]):
+        self.declared = set()
+        for ctx in contexts:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets = [node.target]
+                else:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) and (
+                            _AXIS_NAME_RE.search(t.id)
+                            or t.id == "_default_axis"):
+                        self.declared.update(_collect_strings(node.value))
+
+    def check(self, ctx: FileContext):
+        if not self.declared:
+            return []
+        declared = self.declared
+        findings: List[Finding] = []
+
+        class V(ScopedVisitor):
+            def visit_Call(self, vnode):
+                q = ctx.resolve_call(vnode)
+                if q and q.startswith("jax.lax."):
+                    short = q[len("jax.lax."):]
+                    if short in COLLECTIVES:
+                        axis = _axis_arg(vnode, COLLECTIVES[short])
+                        for name, loc in _axis_literals(ctx, axis):
+                            if name not in declared:
+                                findings.append(ctx.finding(
+                                    RULE, loc,
+                                    f"{short}(...) over axis {name!r} "
+                                    f"which no mesh declares "
+                                    f"(known axes: "
+                                    f"{', '.join(sorted(declared))})",
+                                    self.symbol))
+                self.generic_visit(vnode)
+
+        V().visit(ctx.tree)
+        return findings
+
+
+def _axis_arg(call: ast.Call, pos: int):
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    # jax.lax spells the parameter `axis_name`; a bare `axis=` kwarg on
+    # all_gather/all_to_all is the tensor dimension, not the axis name.
+    return call.args[pos] if len(call.args) > pos else None
+
+
+def _axis_literals(ctx: FileContext, node):
+    """Yield (axis_name, location_node) for statically-known axis args."""
+    if node is None:
+        return
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _axis_literals(ctx, e)
+    elif isinstance(node, ast.Name):
+        val = ctx.module_constants.get(node.id)
+        if val is not None:
+            yield val, node

@@ -1,0 +1,312 @@
+"""tpu-lint core — the AST pass framework.
+
+The reference enforces its invariants mechanically: graph passes over the
+ProgramDesc (paddle/fluid/framework/ir/pass.h) and a YAML op schema that
+drives codegen.  This module is the TPU build's analogue at the Python
+source level: a small pass framework that walks every file's AST once,
+hands each registered :class:`LintPass` a :class:`FileContext` (parsed
+tree + import/alias resolution), and collects :class:`Finding` objects
+(rule id + file:line) that CI turns into failures.
+
+Three suppression channels, in priority order:
+
+* inline — ``# tpu-lint: disable=TPU101`` on the offending line;
+* baseline — an entry in ``tools/tpu_lint_baseline.txt`` (see
+  :mod:`paddle_tpu.analysis.baseline`) carrying a mandatory reason;
+* pass scoping — a pass that cannot establish its preconditions (e.g. no
+  axis declarations in scope) emits nothing rather than guessing.
+
+See ANALYSIS.md at the repo root for the rule catalogue and how to add a
+pass.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "FileContext", "LintPass", "ProjectPass",
+           "ScopedVisitor", "Analyzer", "Report"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete source location."""
+
+    rule: str          # e.g. "TPU101"
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    message: str
+    symbol: str = "<module>"   # qualname of the enclosing def/class
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+
+
+_DISABLE_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Z0-9, ]+)")
+
+
+class FileContext:
+    """Parsed view of one source file shared by every pass.
+
+    Central services:
+
+    * ``aliases`` — import/alias table: ``jnp`` -> ``jax.numpy``,
+      ``ps`` -> ``jax.lax.psum`` (``from jax.lax import psum as ps``).
+      Relative imports resolve against the file's package path.
+    * ``resolve(node)`` — fully-qualified dotted name of a Name/Attribute
+      chain with aliases expanded, or ``None``.
+    * ``module_constants`` — module-level ``NAME = "literal"`` string
+      assignments (axis-name constants etc.).
+    * ``disabled_rules(line)`` — inline suppressions on that line.
+    """
+
+    def __init__(self, path: str, root: str):
+        self.path = os.path.abspath(path)
+        rel = os.path.relpath(self.path, os.path.abspath(root))
+        self.relpath = rel.replace(os.sep, "/")
+        with open(self.path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.relpath)
+        self.aliases: Dict[str, str] = {}
+        self.module_constants: Dict[str, str] = {}
+        self._suppress: Dict[int, set] = {}
+        self._package = self._package_path()
+        self._index()
+
+    # -- construction --------------------------------------------------------
+    def _package_path(self) -> str:
+        """Dotted package containing this module (from relpath)."""
+        parts = self.relpath[:-3].split("/") if self.relpath.endswith(".py") \
+            else self.relpath.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts[:-1]) if len(parts) > 1 else ""
+
+    def _resolve_relative(self, level: int, module: Optional[str]) -> str:
+        base = self._package.split(".") if self._package else []
+        # level=1 -> current package, each extra level pops one more
+        base = base[:len(base) - (level - 1)] if level - 1 else base
+        return ".".join(base + ([module] if module else []))
+
+    def _index(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._resolve_relative(node.level, node.module) \
+                    if node.level else (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = \
+                        f"{mod}.{a.name}" if mod else a.name
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.module_constants[node.targets[0].id] = node.value.value
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self._suppress[i] = {r.strip() for r in m.group(1).split(",")
+                                     if r.strip()}
+
+    # -- services ------------------------------------------------------------
+    def resolve(self, node) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        return self.resolve(call.func)
+
+    def disabled_rules(self, line: int) -> set:
+        return self._suppress.get(line, set())
+
+    def finding(self, rule: str, node, message: str,
+                symbol: str = "<module>") -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, symbol=symbol)
+
+
+class LintPass:
+    """Base class for per-file passes.
+
+    ``prepare(contexts)`` runs once with every context in scope (for
+    cross-file state like axis declarations); ``check(ctx)`` yields
+    findings for one file.
+    """
+
+    rule = "TPU000"
+    name = "base"
+    description = ""
+
+    def prepare(self, contexts: Sequence[FileContext]) -> None:
+        pass
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return []
+
+
+class ProjectPass(LintPass):
+    """A pass that runs once per invocation instead of once per file
+    (e.g. schema drift: the subject is a generated artifact, not a
+    source file)."""
+
+    def check_project(self, root: str,
+                      contexts: Sequence[FileContext]) -> Iterable[Finding]:
+        return []
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing def/class qualname.
+
+    Subclasses read ``self.symbol`` inside any ``visit_*`` and may
+    override ``enter_function(node)`` / ``leave_function(node)`` hooks
+    (the scope stack is maintained here; do not override
+    visit_FunctionDef without calling super).
+    """
+
+    def __init__(self):
+        self._scope: List[str] = []
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def enter_function(self, node):  # hook
+        pass
+
+    def leave_function(self, node):  # hook
+        pass
+
+    def _visit_scoped(self, node):
+        self._scope.append(node.name)
+        self.enter_function(node)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.leave_function(node)
+            self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._scope.pop()
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]                  # live, unsuppressed
+    baselined: List[Finding]                 # matched a baseline entry
+    inline_suppressed: List[Finding]         # # tpu-lint: disable=
+    stale_baseline: List[str]                # entries that matched nothing
+    errors: List[str]                        # unparsable files etc.
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def summary(self) -> str:
+        return (f"{self.files} files, {len(self.findings)} findings, "
+                f"{len(self.baselined)} baselined, "
+                f"{len(self.inline_suppressed)} inline-suppressed, "
+                f"{len(self.stale_baseline)} stale baseline entries")
+
+
+def _iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    out = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+class Analyzer:
+    """Run a set of passes over a file tree and fold in suppressions."""
+
+    def __init__(self, root: Optional[str] = None, passes=None,
+                 baseline_path: Optional[str] = "auto"):
+        from . import ALL_PASSES
+        from .baseline import Baseline
+        self.root = os.path.abspath(root or os.getcwd())
+        self.passes = [p() if isinstance(p, type) else p
+                       for p in (passes if passes is not None
+                                 else ALL_PASSES)]
+        if baseline_path == "auto":
+            baseline_path = os.path.join(self.root, "tools",
+                                         "tpu_lint_baseline.txt")
+            if not os.path.exists(baseline_path):
+                baseline_path = None
+        self.baseline = Baseline.load(baseline_path) if baseline_path \
+            else Baseline([])
+
+    def run(self, paths: Sequence[str]) -> Report:
+        report = Report([], [], [], [], [])
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if not os.path.exists(ap):
+                # a typo'd path must fail loudly — a silent 0-file run
+                # would turn the strict CI gate green while checking nothing
+                report.errors.append(f"{p}: path does not exist")
+        contexts: List[FileContext] = []
+        for path in _iter_py_files(paths, self.root):
+            try:
+                contexts.append(FileContext(path, self.root))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                report.errors.append(f"{path}: {e}")
+        report.files = len(contexts)
+
+        for pz in self.passes:
+            pz.prepare(contexts)
+        raw: List[Finding] = []
+        for pz in self.passes:
+            if isinstance(pz, ProjectPass):
+                raw.extend(pz.check_project(self.root, contexts))
+            else:
+                for ctx in contexts:
+                    raw.extend(pz.check(ctx))
+        raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+        by_line: Dict[str, FileContext] = {c.relpath: c for c in contexts}
+        for f in raw:
+            ctx = by_line.get(f.path)
+            if ctx is not None and f.rule in ctx.disabled_rules(f.line):
+                report.inline_suppressed.append(f)
+            elif self.baseline.matches(f):
+                report.baselined.append(f)
+            else:
+                report.findings.append(f)
+        report.stale_baseline = self.baseline.stale()
+        return report

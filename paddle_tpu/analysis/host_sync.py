@@ -1,0 +1,192 @@
+"""TPU101 — host-sync detector.
+
+A device→host transfer inside a traced/compiled region either fails at
+trace time (``.item()`` on a tracer) or — worse — silently forces a
+blocking round-trip per step when it sneaks into pre/post-processing that
+later migrates under jit.  The reference build never has this problem
+because its hot path is a C++ interpreter; ours is Python all the way to
+the jit boundary, so the boundary must be policed.
+
+Scope: a finding fires only for sync *markers* inside functions that are
+**trace-reachable within the file**:
+
+* decorated with jit/pjit/shard_map/vmap/grad/checkpoint (any alias);
+* passed by name to a trace entry point (``jax.jit(f)``,
+  ``shard_map(f, ...)``, ``jax.lax.scan(body, ...)`` — lax control flow
+  traces its operands even outside jit);
+* a lambda passed inline to one of those calls;
+* called (by local name) from any function already reachable —
+  transitive closure, intra-file only.
+
+Markers: ``.item()`` / ``.numpy()`` / ``.tolist()`` /
+``.block_until_ready()`` calls, ``np.asarray`` / ``np.array`` /
+``jax.device_get``, and ``float(x)`` / ``int(x)`` / ``bool(x)`` applied
+directly to a variable (constants and nested calls like
+``int(np.prod(shape))`` are static at trace time and stay exempt).
+
+Cross-module reachability is intentionally out of scope — the runtime
+HLO audit (tests/test_x64_audit.py) covers whole-program properties; this
+pass exists to catch regressions at review time without a compile.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import FileContext, Finding, LintPass, ScopedVisitor
+
+RULE = "TPU101"
+
+#: decorator / wrapper qualnames whose function arguments are traced.
+TRACE_ENTRY_SUFFIXES = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.experimental.shard_map.shard_map", "jax.vmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.lax.map",
+}
+#: bare names that count even when alias resolution fails.
+TRACE_ENTRY_BARE = {"jit", "pjit", "shard_map", "to_static"}
+
+SYNC_METHODS = {"item", "numpy", "tolist", "block_until_ready"}
+SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get",
+              "jax.block_until_ready"}
+SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_trace_entry(ctx: FileContext, node) -> bool:
+    """Is `node` (a decorator expr or call-func expr) a trace entry?"""
+    if isinstance(node, ast.Call):
+        node = node.func
+    q = ctx.resolve(node)
+    if q is None:
+        return False
+    if q in TRACE_ENTRY_SUFFIXES:
+        return True
+    last = q.rsplit(".", 1)[-1]
+    return last in TRACE_ENTRY_BARE
+
+
+class _Graph(ScopedVisitor):
+    """First walk: function table, local call graph, trace seeds."""
+
+    def __init__(self, ctx: FileContext):
+        super().__init__()
+        self.ctx = ctx
+        self.defs: Dict[str, ast.AST] = {}          # qualname -> def node
+        self.by_name: Dict[str, List[str]] = {}     # bare name -> qualnames
+        self.calls: Dict[str, Set[str]] = {}        # qualname -> bare names
+        self.seeds: Set[str] = set()                # reachable roots
+        self.seed_lambdas: List[ast.Lambda] = []    # lambdas passed to jit
+
+    def enter_function(self, node):
+        q = self.symbol
+        self.defs[q] = node
+        self.by_name.setdefault(node.name, []).append(q)
+        self.calls.setdefault(q, set())
+        for dec in node.decorator_list:
+            if _is_trace_entry(self.ctx, dec):
+                self.seeds.add(q)
+
+    def visit_Call(self, node):
+        sym = self.symbol
+        if sym != "<module>":
+            f = node.func
+            if isinstance(f, ast.Name):
+                self.calls.setdefault(sym, set()).add(f.id)
+            elif isinstance(f, ast.Attribute):
+                # self._helper(...) — bare method-name edge
+                self.calls.setdefault(sym, set()).add(f.attr)
+        if _is_trace_entry(self.ctx, node.func):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.seeds.add(arg.id)          # bare name; mapped later
+                elif isinstance(arg, ast.Attribute):
+                    self.seeds.add(arg.attr)        # jax.jit(self._method)
+                elif isinstance(arg, ast.Lambda):
+                    self.seed_lambdas.append(arg)
+        self.generic_visit(node)
+
+
+class _MarkerScan(ast.NodeVisitor):
+    """Scan one reachable function body for sync markers, skipping nested
+    defs/lambdas (they are judged by their own reachability)."""
+
+    def __init__(self, ctx: FileContext, symbol: str, skip_nested=True):
+        self.ctx = ctx
+        self.symbol = symbol
+        self.skip_nested = skip_nested
+        self.findings: List[Finding] = []
+
+    def visit_FunctionDef(self, node):
+        if not self.skip_nested:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if not self.skip_nested:
+            self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in SYNC_METHODS \
+                and not node.args:
+            self._flag(node, f".{f.attr}() forces a device→host sync "
+                             f"inside a traced function")
+        else:
+            q = self.ctx.resolve(f)
+            if q in SYNC_CALLS:
+                self._flag(node, f"{q} materializes a traced value on "
+                                 f"host")
+            elif isinstance(f, ast.Name) and f.id in SYNC_BUILTINS \
+                    and q == f.id and len(node.args) == 1 \
+                    and not node.keywords \
+                    and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+                self._flag(node, f"{f.id}(...) on a traced value forces "
+                                 f"concretization (host sync)")
+        self.generic_visit(node)
+
+    def _flag(self, node, msg):
+        self.findings.append(self.ctx.finding(RULE, node, msg, self.symbol))
+
+
+class HostSyncPass(LintPass):
+    rule = RULE
+    name = "host-sync"
+    description = ("device→host sync (.item()/np.asarray/float()/...) "
+                   "reachable from a jitted function")
+
+    def check(self, ctx: FileContext):
+        g = _Graph(ctx)
+        g.visit(ctx.tree)
+
+        # seeds arrive as qualnames (decorators) or bare names (call args)
+        reachable: Set[str] = set()
+        frontier: List[str] = []
+        for s in g.seeds:
+            for q in ([s] if s in g.defs else g.by_name.get(s, [])):
+                if q not in reachable:
+                    reachable.add(q)
+                    frontier.append(q)
+        while frontier:
+            q = frontier.pop()
+            for callee in g.calls.get(q, ()):
+                for cq in g.by_name.get(callee, []):
+                    if cq not in reachable:
+                        reachable.add(cq)
+                        frontier.append(cq)
+
+        findings: List[Finding] = []
+        for q in sorted(reachable):
+            node = g.defs[q]
+            scan = _MarkerScan(ctx, q)
+            for stmt in node.body:
+                scan.visit(stmt)
+            findings.extend(scan.findings)
+        for lam in g.seed_lambdas:
+            scan = _MarkerScan(ctx, "<lambda>")
+            scan.visit(lam.body)
+            findings.extend(scan.findings)
+        return findings

@@ -1,0 +1,106 @@
+"""TPU401 — op-schema drift validator.
+
+The reference generates its op surface *from* yaml
+(python/paddle/utils/code_gen/api.yaml); this build inverts that and
+generates ``ops_schema.yaml`` from the live ``paddle_tpu.ops`` surface
+(:mod:`paddle_tpu.ops.schema`).  Either direction, the invariant is the
+same: the committed schema and the code must agree.  This project-level
+pass regenerates the schema in memory and diffs it against the committed
+yaml:
+
+* op in yaml but gone from the live surface — removed/renamed op;
+* live op missing from yaml — new op not committed;
+* parameter *name* list mismatch for **paddle_tpu-authored ops** —
+  signature drift we control.
+
+Pass-through ops (module ``jax.numpy``/``jax.lax``) are only checked for
+presence: their parameter lists, defaults, and defining-module paths all
+move with the installed jax version (``out_sharding`` appearing on
+``matmul``, ``jax.lax`` → ``jax._src.lax.lax``) without changing the op
+surface this repo authors, and comparing them would make the gate flap
+on every toolchain bump.  ``python -m paddle_tpu.ops.schema`` refreshes
+the committed file when the surface really changes.
+
+Findings anchor to the op's line in ops_schema.yaml so the fix location
+is one click away.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .core import FileContext, Finding, ProjectPass
+
+RULE = "TPU401"
+
+_OP_RE = re.compile(r"^- name: (\S+)$")
+_PARAM_RE = re.compile(r"^  - \{name: ([^,}]+)")
+
+
+def parse_schema_yaml(path: str) -> Dict[str, Tuple[int, List[str]]]:
+    """Parse the generator's own minimal-YAML dialect:
+    op name -> (line number, [param names])."""
+    ops: Dict[str, Tuple[int, List[str]]] = {}
+    current = None
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n")
+            m = _OP_RE.match(line)
+            if m:
+                current = m.group(1)
+                ops[current] = (lineno, [])
+                continue
+            m = _PARAM_RE.match(line)
+            if m and current is not None:
+                ops[current][1].append(m.group(1).strip())
+    return ops
+
+
+class SchemaDriftPass(ProjectPass):
+    rule = RULE
+    name = "op-schema-drift"
+    description = ("ops_schema.yaml out of sync with the live "
+                   "paddle_tpu.ops surface")
+
+    def __init__(self, schema_path: str = None):
+        self._schema_path = schema_path
+
+    def check_project(self, root: str,
+                      contexts: Sequence[FileContext]) -> List[Finding]:
+        path = self._schema_path or os.path.join(root, "ops_schema.yaml")
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if not os.path.exists(path):
+            return []   # nothing committed to validate against
+        try:
+            from ..ops.schema import generate_schema
+            live = {op["name"]: ([p["name"] for p in op["params"]],
+                                 str(op.get("module", "")))
+                    for op in generate_schema()}
+        except Exception as e:   # import failure = env problem, not drift
+            return [Finding(RULE, rel, 1, 0,
+                            f"could not introspect live op surface: {e}",
+                            "<schema>")]
+        committed = parse_schema_yaml(path)
+        regen = ("stale ops_schema.yaml — regenerate with "
+                 "`python -m paddle_tpu.ops.schema`")
+        findings: List[Finding] = []
+        for name, (line, params) in sorted(committed.items()):
+            if name not in live:
+                findings.append(Finding(
+                    RULE, rel, line, 0,
+                    f"op {name!r} is in the schema but not on the live "
+                    f"paddle_tpu.ops surface; {regen}", "<schema>"))
+            elif params != live[name][0] \
+                    and live[name][1].startswith("paddle_tpu"):
+                findings.append(Finding(
+                    RULE, rel, line, 0,
+                    f"op {name!r} params drifted: schema has "
+                    f"{params}, live signature has {live[name][0]}; {regen}",
+                    "<schema>"))
+        for name in sorted(set(live) - set(committed)):
+            findings.append(Finding(
+                RULE, rel, 1, 0,
+                f"live op {name!r} missing from the schema; {regen}",
+                "<schema>"))
+        return findings

@@ -1,0 +1,160 @@
+"""TPU201 — x64-widening detector.
+
+``paddle_tpu/__init__.py`` enables ``jax_enable_x64`` globally (paddle's
+int64 index semantics require it), which flips JAX's *default* dtypes to
+float64/int64.  Any array created without an explicit dtype therefore
+lands wide, and f64 on TPU is emulated — orders of magnitude slower than
+f32.  The runtime HLO audit (tests/test_x64_audit.py) catches leaks that
+reach a compiled train step; this pass catches them at the source line,
+over the whole tree, without compiling anything.
+
+What fires:
+
+* 64-bit dtype *mentions* used as call arguments — ``astype(jnp.int64)``,
+  ``jnp.asarray(x, np.float64)``, ``dtype="float64"``.  float64/double/
+  complex128 attribute mentions additionally fire anywhere outside a
+  comparison (``x.dtype == np.float64`` is a read, not a widening).
+* dtype-less float-typed creation — ``jnp.zeros(shape)``, ``jnp.ones``,
+  ``jnp.full``, ``jnp.empty``, ``jnp.linspace`` with no dtype argument,
+  ``jnp.arange`` with a float literal bound, and ``jnp.array``/
+  ``jnp.asarray`` of a bare Python float literal (or list thereof):
+  under x64 all of these produce f64.
+
+What deliberately does NOT fire:
+
+* integer ``jnp.arange(n)`` and friends — s64 *indices* are the point of
+  enabling x64 (paddle parity); the runtime audit allows s64 inputs and
+  only treats s64 **compute** (:data:`S64_COMPUTE_OPS`) as a leak, and
+  the static rule mirrors that split.
+* bare float literals in arithmetic (``x * 0.5``) — JAX weak typing
+  keeps Python scalars from committing a dtype.
+
+The constants below are the shared vocabulary between this pass and the
+runtime audit, so the two checks cannot silently diverge.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import FileContext, Finding, LintPass, ScopedVisitor
+
+RULE = "TPU201"
+
+#: HLO op mnemonics on s64 operands that the *runtime* audit treats as a
+#: leak (s64 params/constants are allowed: labels land as s64 under x64).
+#: tests/test_x64_audit.py imports this — single source of truth.
+S64_COMPUTE_OPS = ("multiply", "add", "subtract", "divide", "convert")
+
+#: dtype names that are always a widening when passed as a dtype argument.
+WIDE_DTYPE_NAMES = frozenset({"float64", "double", "complex128", "int64",
+                              "longlong"})
+#: the float subset additionally fires outside call arguments.
+WIDE_FLOAT_NAMES = frozenset({"float64", "double", "complex128"})
+
+#: jax.numpy creation functions with a float default dtype (f64 under x64
+#: when no dtype is given).  Value = index of the positional dtype slot.
+_FLOAT_CREATORS = {"jax.numpy.zeros": 1, "jax.numpy.ones": 1,
+                   "jax.numpy.empty": 1, "jax.numpy.full": 2,
+                   "jax.numpy.linspace": 5}
+_ARRAY_CTORS = {"jax.numpy.array": 1, "jax.numpy.asarray": 1}
+
+
+def _has_dtype(call: ast.Call, pos: int) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return len(call.args) > pos
+
+
+def _is_float_literal(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub,
+                                                              ast.UAdd)):
+        return _is_float_literal(node.operand)
+    return False
+
+
+def _holds_float_literal(node, depth=0) -> bool:
+    if _is_float_literal(node):
+        return True
+    if depth < 2 and isinstance(node, (ast.List, ast.Tuple)):
+        return any(_holds_float_literal(e, depth + 1) for e in node.elts)
+    return False
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, ctx: FileContext):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        # attribute nodes appearing inside comparisons are dtype *reads*
+        self._compare_attrs: Set[int] = set()
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Compare):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Attribute):
+                        self._compare_attrs.add(id(sub))
+        self._call_args: Set[int] = set()
+
+    def _flag(self, node, msg):
+        self.findings.append(self.ctx.finding(RULE, node, msg, self.symbol))
+
+    def _is_device_dtype(self, attr: ast.Attribute) -> bool:
+        """int64 only counts against device (jax.numpy / paddle dtype
+        registry) references — ``np.int64`` labels in host-side dataset
+        loaders are paddle parity, not a TPU widening (the runtime audit
+        allows s64 *inputs* for the same reason).  The float64 family is
+        flagged regardless of base."""
+        if attr.attr in WIDE_FLOAT_NAMES:
+            return True
+        base = self.ctx.resolve(attr.value) or ""
+        return base == "jax.numpy" or base.endswith("core.dtype") \
+            or base == "paddle_tpu"
+
+    def visit_Call(self, node):
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            self._call_args.add(id(arg))
+            if isinstance(arg, ast.Attribute) \
+                    and arg.attr in WIDE_DTYPE_NAMES \
+                    and self._is_device_dtype(arg):
+                self._flag(arg, f"64-bit dtype {arg.attr!r} passed as an "
+                                f"argument widens under global x64")
+            elif isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value in WIDE_DTYPE_NAMES:
+                self._flag(arg, f"64-bit dtype string {arg.value!r} widens "
+                                f"under global x64")
+        q = self.ctx.resolve_call(node)
+        if q in _FLOAT_CREATORS and not _has_dtype(node,
+                                                   _FLOAT_CREATORS[q]):
+            self._flag(node, f"{q.split('.')[-1]}(...) without dtype "
+                             f"defaults to float64 under global x64")
+        elif q == "jax.numpy.arange" and not _has_dtype(node, 3) \
+                and any(_is_float_literal(a) for a in node.args):
+            self._flag(node, "arange(...) with a float bound and no dtype "
+                             "produces float64 under global x64")
+        elif q in _ARRAY_CTORS and not _has_dtype(node, _ARRAY_CTORS[q]) \
+                and node.args and _holds_float_literal(node.args[0]):
+            self._flag(node, f"{q.split('.')[-1]}(<float literal>) without "
+                             f"dtype produces float64 under global x64")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in WIDE_FLOAT_NAMES and id(node) not in self._call_args \
+                and id(node) not in self._compare_attrs:
+            self._flag(node, f"64-bit float dtype {node.attr!r} mentioned "
+                             f"(f64 is emulated on TPU)")
+        self.generic_visit(node)
+
+
+class X64WideningPass(LintPass):
+    rule = RULE
+    name = "x64-widening"
+    description = ("float64/int64 dtype mentions and dtype-less creation "
+                   "that widen under the globally-enabled x64 mode")
+
+    def check(self, ctx: FileContext):
+        v = _Visitor(ctx)
+        v.visit(ctx.tree)
+        return v.findings

@@ -726,7 +726,10 @@ class _CompiledPipelineStep:
 
     def _build(self):
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax: only the experimental spelling
+            from jax.experimental.shard_map import shard_map
 
         n, m, bps = self._num_stages, self._num_micro, self._bps
         pspec = {"embed": jax.tree_util.tree_map(

@@ -72,7 +72,7 @@ class Normal(Distribution):
 
     def entropy(self):
         return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
-                      + jnp.zeros(self._batch_shape))
+                      + jnp.zeros(self._batch_shape, jnp.float32))
 
     @property
     def mean(self):
@@ -105,7 +105,8 @@ class Uniform(Distribution):
         return Tensor(jnp.where(inside, lp, -jnp.inf))
 
     def entropy(self):
-        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(self._batch_shape))
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self._batch_shape, jnp.float32))
 
 
 class Bernoulli(Distribution):

@@ -109,7 +109,7 @@ def convert_bool(pred):
     a = _as_array(pred)
     if hasattr(a, "dtype"):
         return jnp.asarray(a).astype(bool).reshape(())
-    return bool(pred)
+    return bool(pred)  # tpu-lint: disable=TPU101 — untraced fallback, guarded by the hasattr above
 
 
 def _rewrap(arrs, like):

@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..core.dtype import x64_scope
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
 DEFAULT_BLOCK_ROWS = 8
@@ -104,20 +106,20 @@ def _ce_bwd(x2, y2, lse, g, interpret):
 def softmax_ce_pallas(logits2, labels2, interpret=False):
     """logits2: (N, V); labels2: (N, 1) int32 (pre-clipped to [0, V)).
     Returns per-row nll (N,) f32."""
-    with jax.enable_x64(False):
+    with x64_scope(False):
         nll, _ = _ce_fwd(logits2, labels2, interpret)
     return nll[:, 0]
 
 
 def _vjp_fwd(logits2, labels2, interpret):
-    with jax.enable_x64(False):
+    with x64_scope(False):
         nll, lse = _ce_fwd(logits2, labels2, interpret)
     return nll[:, 0], (logits2, labels2, lse)
 
 
 def _vjp_bwd(interpret, res, g):
     logits2, labels2, lse = res
-    with jax.enable_x64(False):
+    with x64_scope(False):
         dx = _ce_bwd(logits2, labels2, lse,
                      g.astype(jnp.float32)[:, None], interpret)
     return dx, None
@@ -220,12 +222,12 @@ def logsumexp_pallas(logits2, interpret=False):
     """One-pass streamed logsumexp over the last axis of (N, V) logits.
     Returns (N,) f32 in base-e units.  Backward is the standard softmax
     pullback as plain jnp (XLA fuses it into the dlogits consumers)."""
-    with jax.enable_x64(False):
+    with x64_scope(False):
         return _lse_call(logits2, interpret)[:, 0]
 
 
 def _lse_vjp_fwd(logits2, interpret):
-    with jax.enable_x64(False):
+    with x64_scope(False):
         lse = _lse_call(logits2, interpret)[:, 0]
     return lse, (logits2, lse)
 

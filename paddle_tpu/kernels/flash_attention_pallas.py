@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core.dtype import x64_scope
+
 
 def _block_env(name, default):
     """Power-of-two >=128 only: the divisibility-fallback loop in
@@ -370,7 +372,7 @@ def _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k, hg, d,
                interpret=False):
     # trace with x64 off: the global x64 mode (needed for paddle's int64
     # semantics) surfaces i64/f64 intermediates that mosaic cannot lower
-    with jax.enable_x64(False):
+    with x64_scope(False):
         return _flash_fwd_inner(q3, k3, v3, causal, scale, block_q, block_k,
                                 hg, d, interpret)
 
@@ -701,7 +703,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, block_q, block_k,
     # (flash_attention_bshd_with_lse): it folds into the kernels as
     # delta - dlse — dS_ij = P_ij (dP_ij - delta_i + dlse_i), so the
     # existing kernels run unchanged
-    with jax.enable_x64(False):
+    with x64_scope(False):
         s = max(q3.shape[1], k3.shape[1])
         if s * hg * d * 4 > _DQ_SCRATCH_BUDGET:
             # long sequence: the merged kernel's full-seq dq scratch would

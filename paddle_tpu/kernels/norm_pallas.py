@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..core.dtype import x64_scope
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
 DEFAULT_BLOCK_ROWS = 256
@@ -147,7 +149,7 @@ def _ln_core(x, gamma, beta, eps, block_rows, interpret):
         raise ValueError(
             f"layer_norm_pallas: shape ({n}, {f}) not tileable "
             f"(rows %% {br}, feature %% 128)")
-    with jax.enable_x64(False):
+    with x64_scope(False):
         out, mean, rstd = _ln_fwd(x2, gamma, beta, eps, br, interpret)
     return out.reshape(x.shape), mean, rstd
 
@@ -165,7 +167,7 @@ def _ln_vjp_bwd(eps, block_rows, interpret, res, g):
     br = min(block_rows, n)
     while br > 8 and n % br:
         br //= 2
-    with jax.enable_x64(False):
+    with x64_scope(False):
         dx, dg, db = _ln_bwd(x2, gamma, mean, rstd, g.reshape(-1, f), br,
                              interpret)
     return (dx.reshape(x.shape), dg.astype(gamma.dtype),
@@ -200,7 +202,7 @@ def softmax_pallas(x, block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
     if n % br or not _supported_feature_dim(f):
         raise ValueError(
             f"softmax_pallas: shape ({n}, {f}) not tileable")
-    with jax.enable_x64(False):
+    with x64_scope(False):
         out = pl.pallas_call(
             _softmax_kernel,
             grid=(n // br,),

@@ -10,7 +10,7 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
     """In-place global-norm clip over parameters' ``.grad``."""
     grads = [p.grad for p in parameters if p.grad is not None]
     if not grads:
-        return Tensor(jnp.asarray(0.0))
+        return Tensor(jnp.asarray(0.0, jnp.float32))
     if norm_type == float("inf"):
         total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._array)) for g in grads]))
     else:

@@ -69,7 +69,11 @@ def alpha_dropout(x, p=0.5, training=True):
 
 @wrap_op
 def embedding(x, weight, padding_idx=None, sparse=False):
-    out = jnp.take(weight, x, axis=0)
+    # bracket indexing (not jnp.take): take's fill/clip modes route index
+    # math through s64 under global x64 — in the forward gather and again
+    # in the scatter-add transpose — putting emulated 64-bit ops into TPU
+    # programs (tests/test_x64_audit.py); w[x] stays in the input's i32
+    out = weight[x]
     if padding_idx is not None and padding_idx >= 0:
         mask = (x == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros((), out.dtype), out)
@@ -183,9 +187,9 @@ def _resize_align_corners(a, out_spatial, method):
     coords = []
     for s_in, s_out in zip(spatial, out_spatial):
         if s_out == 1:
-            c = jnp.zeros((1,))
+            c = jnp.zeros((1,), jnp.float32)
         else:
-            c = jnp.linspace(0.0, s_in - 1.0, s_out)
+            c = jnp.linspace(0.0, s_in - 1.0, s_out, dtype=jnp.float32)
         coords.append(c)
     mesh = jnp.meshgrid(*coords, indexing="ij")
     order = 0 if method == "nearest" else 1

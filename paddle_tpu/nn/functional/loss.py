@@ -49,11 +49,11 @@ def _fused_ce_or_none(logits, lbl, ignore_index):
     from ...kernels import ce_pallas
     if not ce_pallas.supported(n, v):
         return None
-    # index math under x64-off: s64 labels would otherwise put emulated
-    # 64-bit clamp/convert ops into the program (tests/test_x64_audit.py)
-    with jax.enable_x64(False):
-        idx = jnp.clip(lbl.astype(jnp.int32), 0, v - 1).reshape(n, 1)
-        nll = ce_pallas.softmax_ce_pallas(logits.reshape(n, v), idx)
+    # explicit i32 index math, no x64 flip at this level (flipping x64
+    # inside an outer trace miscompiles on newer jax — see the XLA gather
+    # below); softmax_ce_pallas scopes its own kernel lowering internally
+    idx = jnp.clip(lbl.astype(jnp.int32), 0, v - 1).reshape(n, 1)
+    nll = ce_pallas.softmax_ce_pallas(logits.reshape(n, v), idx)
     nll = nll.reshape(lead)
     mask = (lbl != ignore_index)
     return jnp.where(mask, nll, 0.0)
@@ -123,13 +123,16 @@ def softmax_with_cross_entropy_raw(logits, label, soft_label=False,
         lse = mf + jnp.log(jnp.sum(
             jnp.exp(logits.astype(jnp.float32) - jnp.expand_dims(mf, axis)),
             axis=axis))
-    # gather under x64-off: take_along_axis promotes its index math to
-    # s64 in x64 mode, putting emulated 64-bit ops into the TPU program
-    # (caught by tests/test_x64_audit.py)
-    with jax.enable_x64(False):
-        idx = jnp.clip(lbl, 0, logits.shape[axis] - 1).astype(jnp.int32)
-        t = jnp.take_along_axis(logits, jnp.expand_dims(idx, axis),
-                                axis=axis).astype(jnp.float32)
+    # cast BEFORE the clip so every index op is i32: s64 labels would
+    # otherwise put emulated 64-bit clamp/compare ops into the TPU program
+    # (caught by tests/test_x64_audit.py; an earlier revision toggled
+    # x64_scope(False) here, but flipping x64 inside an outer trace
+    # miscompiles on newer jax — explicit casts are trace-stable)
+    idx = jnp.clip(lbl.astype(jnp.int32), 0, logits.shape[axis] - 1)
+    # promise_in_bounds is honest (idx just got clipped) and keeps the
+    # gather + its transpose in i32; other modes convert through s64
+    t = jnp.take_along_axis(logits, jnp.expand_dims(idx, axis), axis=axis,
+                            mode="promise_in_bounds").astype(jnp.float32)
     nll = lse - jnp.squeeze(t, axis)
     mask = (lbl != ignore_index)
     return jnp.where(mask, nll, 0.0)
@@ -335,7 +338,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         ext = ext.at[1::2].set(lab_b)
         L = 2 * l_len + 1
         neg_inf = -1e30
-        alpha = jnp.full((2 * S + 1,), neg_inf)
+        alpha = jnp.full((2 * S + 1,), neg_inf, jnp.float32)
         alpha = alpha.at[0].set(lp_b[0, blank])
         alpha = alpha.at[1].set(jnp.where(l_len > 0, lp_b[0, ext[1]], neg_inf))
 
@@ -343,8 +346,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             [jnp.array([True, True]), ext[2:] == ext[:-2]])
 
         def step(alpha, lp_t):
-            a_prev = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
-            a_prev2 = jnp.concatenate([jnp.array([neg_inf, neg_inf]), alpha[:-2]])
+            a_prev = jnp.concatenate(
+                [jnp.array([neg_inf], jnp.float32), alpha[:-1]])
+            a_prev2 = jnp.concatenate(
+                [jnp.array([neg_inf, neg_inf], jnp.float32), alpha[:-2]])
             a_prev2 = jnp.where(same_as_prev2, neg_inf, a_prev2)
             merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev), a_prev2)
             new_alpha = merged + lp_t[ext]

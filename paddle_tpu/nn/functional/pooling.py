@@ -71,7 +71,7 @@ def _pool_argmax(x, ks, st, pad, channel_last):
         bv, bi = b
         take_b = bv > av
         return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0))
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0, jnp.float32))
     vals, idx = jax.lax.reduce_window(
         (x, lin), init, reducer,
         (1, 1) + ks, (1, 1) + st,

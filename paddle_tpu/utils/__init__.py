@@ -18,7 +18,7 @@ def run_check():
     """paddle.utils.run_check equivalent: verify the accelerator works."""
     import jax
     import jax.numpy as jnp
-    x = jnp.ones((128, 128))
+    x = jnp.ones((128, 128), jnp.float32)
     y = (x @ x).block_until_ready()
     n = jax.device_count()
     print(f"paddle_tpu works! backend={jax.default_backend()}, devices={n}")

@@ -1,0 +1,13 @@
+"""tpu-lint fixture: exercises every rule's trigger surface, cleanly —
+the negative case that keeps the passes from over-firing."""
+import jax
+import jax.numpy as jnp
+
+AXIS_ORDER = ("dp", "mp")
+
+
+@jax.jit
+def stepper(x):
+    y = jnp.zeros(x.shape, jnp.float32)     # dtype given: no TPU201
+    n = int(1024)                           # literal arg: no TPU101
+    return jax.lax.psum(x + y, "dp") / n    # declared axis: no TPU301

@@ -1,0 +1,8 @@
+"""tpu-lint fixture: triggers exactly one TPU301 (collective-axis) finding."""
+import jax
+
+MODEL_AXIS = "mp"                   # declares axis 'mp'
+
+
+def bad_reduce(x):
+    return jax.lax.psum(x, "mdl")   # line 8: TPU301 — typo for 'mp'

@@ -1,0 +1,16 @@
+"""tpu-lint fixture: triggers exactly one TPU101 (host-sync) finding.
+
+The .item() below sits in a helper reached transitively from a jitted
+function — the transitive case is the one worth pinning, since direct
+markers are easy and the call-graph closure is where bugs would hide.
+"""
+import jax
+
+
+def _log_scale(x):
+    return x.mean().item()          # line 11: TPU101
+
+
+@jax.jit
+def train_step(x):
+    return x * _log_scale(x)

@@ -1,0 +1,9 @@
+"""tpu-lint fixture: a real TPU101 marker silenced by an inline
+``# tpu-lint: disable=`` comment — must count as suppressed, not live."""
+import jax
+
+
+@jax.jit
+def debug_step(x):
+    host = x.item()  # tpu-lint: disable=TPU101 — debug-only fixture
+    return x + host

@@ -1,0 +1,6 @@
+"""tpu-lint fixture: triggers exactly one TPU201 (x64-widening) finding."""
+import jax.numpy as jnp
+
+
+def make_state(n):
+    return jnp.zeros((n, n))        # line 6: TPU201 — f64 under global x64

@@ -1,0 +1,142 @@
+"""tpu-lint (paddle_tpu.analysis) — tier-1 gate.
+
+Two jobs: (1) pin each pass's detection on seeded fixture violations
+(exact rule id + file:line), (2) run the whole paddle_tpu/ tree in strict
+mode so any new violation fails CI — the static generalization of the
+runtime HLO audit in tests/test_x64_audit.py (which shares rule TPU201's
+s64 allowlist via paddle_tpu.analysis.S64_COMPUTE_OPS).
+"""
+import os
+
+import pytest
+
+from paddle_tpu.analysis import (ALL_PASSES, RULES, S64_COMPUTE_OPS,
+                                 Analyzer, SchemaDriftPass)
+from paddle_tpu.analysis.baseline import Baseline, BaselineFormatError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+
+
+def _fixture_report(baseline_path=None):
+    an = Analyzer(root=REPO, baseline_path=baseline_path)
+    return an.run([FIXTURES])
+
+
+def test_rule_catalogue():
+    assert set(RULES) == {"TPU101", "TPU201", "TPU301", "TPU401"}
+    assert len(ALL_PASSES) == 4
+
+
+def test_fixture_matrix():
+    """Each seeded fixture trips exactly its one rule, at the right line;
+    the clean fixture trips nothing."""
+    report = _fixture_report()
+    by_file = {}
+    for f in report.findings:
+        by_file.setdefault(os.path.basename(f.path), []).append(f)
+    assert sorted(by_file) == ["collective_bad.py", "host_sync_bad.py",
+                               "x64_bad.py"]
+
+    (hs,) = by_file["host_sync_bad.py"]
+    assert hs.rule == "TPU101" and hs.line == 11
+    assert hs.path == "tests/analysis_fixtures/host_sync_bad.py"
+    assert hs.symbol == "_log_scale"       # reached transitively from @jit
+
+    (x64,) = by_file["x64_bad.py"]
+    assert x64.rule == "TPU201" and x64.line == 6
+
+    (col,) = by_file["collective_bad.py"]
+    assert col.rule == "TPU301" and col.line == 8
+    assert "'mdl'" in col.message and "mp" in col.message
+
+
+def test_inline_suppression():
+    report = _fixture_report()
+    sup = [f for f in report.inline_suppressed
+           if f.path.endswith("inline_suppressed.py")]
+    assert len(sup) == 1 and sup[0].rule == "TPU101"
+    assert not any(f.path.endswith("inline_suppressed.py")
+                   for f in report.findings)
+
+
+def test_baseline_suppression(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "TPU101 tests/analysis_fixtures/host_sync_bad.py::_log_scale"
+        "  # fixture: accepted for the baseline test\n"
+        "TPU999 tests/analysis_fixtures/clean.py  # never matches\n")
+    report = _fixture_report(baseline_path=str(bl))
+    assert not any(f.path.endswith("host_sync_bad.py")
+                   for f in report.findings)
+    assert any(f.path.endswith("host_sync_bad.py") for f in report.baselined)
+    # the unmatched entry is surfaced as stale, not silently ignored
+    assert len(report.stale_baseline) == 1
+    assert "TPU999" in report.stale_baseline[0]
+
+
+def test_baseline_requires_reason(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("TPU101 some/file.py::fn\n")
+    with pytest.raises(BaselineFormatError):
+        Baseline.load(str(bl))
+
+
+def test_schema_drift_detected(tmp_path):
+    fake = tmp_path / "ops_schema.yaml"
+    fake.write_text("ops:\n"
+                    "- name: __no_such_op__\n"
+                    "  module: x\n"
+                    "  differentiable: false\n"
+                    "  params: []\n")
+    findings = list(SchemaDriftPass(schema_path=str(fake))
+                    .check_project(REPO, []))
+    ghost = [f for f in findings if "__no_such_op__" in f.message]
+    assert ghost and ghost[0].rule == "TPU401" and ghost[0].line == 2
+    # every real op is also reported missing from the fake schema
+    assert any("missing from the schema" in f.message for f in findings)
+
+
+def test_schema_green_on_tree():
+    """ops_schema.yaml is committed in sync with the live surface."""
+    findings = list(SchemaDriftPass().check_project(REPO, []))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_whole_tree_strict_green():
+    """THE gate: every finding in paddle_tpu/ is fixed or carries a
+    baselined reason, and the baseline holds no dead weight."""
+    an = Analyzer(root=REPO)
+    report = an.run([os.path.join(REPO, "paddle_tpu")])
+    assert report.ok, "new tpu-lint findings:\n" + \
+        "\n".join(f.format() for f in report.findings)
+    assert not report.stale_baseline, \
+        "stale baseline entries:\n" + "\n".join(report.stale_baseline)
+    # the tree genuinely exercises the framework
+    assert report.files > 100
+    assert report.baselined, "baseline expected to cover accepted debt"
+
+
+def test_missing_path_is_an_error():
+    """A typo'd path must not turn the strict gate silently green."""
+    report = Analyzer(root=REPO, baseline_path=None).run(["no_such_dir_xyz"])
+    assert not report.ok and report.errors
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["no_such_dir_xyz", "--root", REPO, "--strict", "-q"]) == 2
+
+
+def test_cli_strict_exit_codes(tmp_path):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["paddle_tpu", "--root", REPO, "--strict", "-q"]) == 0
+    # violations without a baseline exit 1 under --strict, 0 without
+    args = [os.path.join(FIXTURES, "x64_bad.py"), "--root", REPO,
+            "--baseline", "none", "-q"]
+    assert main(args + ["--strict"]) == 1
+    assert main(args) == 0
+    # rule selection: only the host-sync pass runs, so x64_bad is clean
+    assert main(args + ["--strict", "--select", "TPU101"]) == 0
+
+
+def test_shared_s64_allowlist():
+    """The runtime HLO audit and the static rule share one vocabulary."""
+    assert "convert" in S64_COMPUTE_OPS and "multiply" in S64_COMPUTE_OPS

@@ -2,7 +2,13 @@
 paddle's int64 semantics; any stray Python-float/int promotion would put
 f64/s64 ops into TPU programs (emulated, slow).  This compiles
 representative training steps and asserts the optimized HLO contains NO
-f64/s64 tensors."""
+f64/s64 tensors.
+
+Static counterpart: rule TPU201 in paddle_tpu.analysis (tpu-lint, see
+ANALYSIS.md and tests/test_static_analysis.py) flags the same widenings at
+the source line without compiling.  The s64-compute allowlist below is
+imported from the analyzer (S64_COMPUTE_OPS) so the two checks share one
+definition of "leak" and cannot silently diverge."""
 import re
 
 import numpy as np
@@ -10,6 +16,7 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
+from paddle_tpu.analysis import S64_COMPUTE_OPS
 
 
 def _assert_no_wide_types(hlo: str, allow_s64_params=False):
@@ -19,7 +26,7 @@ def _assert_no_wide_types(hlo: str, allow_s64_params=False):
         # s64 is allowed only for integer *inputs* the user supplied (labels
         # land as s64 under x64); compute ops on s64 are the leak signal.
         # Heuristic: converts/multiplies/adds producing s64.
-        for op in ("multiply", "add", "subtract", "divide", "convert"):
+        for op in S64_COMPUTE_OPS:
             pat = re.compile(r"s64\[[0-9,]*\]\S* " + op + r"\(")
             assert not pat.search(hlo), f"s64 {op} op leaked into program"
 
