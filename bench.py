@@ -86,6 +86,12 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / A100_TOKENS_PER_SEC, 4),
     }
+    # tie the number to the kernel configs that actually ran (autotuned,
+    # cached or hand-tuned defaults — kernels/autotune.py)
+    from paddle_tpu.kernels import autotune
+    chosen = autotune.report()
+    if chosen:
+        result["autotune"] = chosen
     print(json.dumps(result))
 
 

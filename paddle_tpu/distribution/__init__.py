@@ -381,33 +381,31 @@ def _kl_expfamily_expfamily(p, q):
     return Tensor(kl)
 
 
+def _dispatch_kl(type_p, type_q):
+    """Most-specific registered ancestor pair for (type_p, type_q) — the
+    reference dispatcher (kl.py _dispatch_kl) resolves SUBCLASSES, not just
+    exact types: all (cls_p, cls_q) pairs with issubclass matches are
+    ranked by (mro-distance of cls_p, mro-distance of cls_q) and the
+    closest pair wins (left argument tie-broken first, like the
+    reference's total ordering on _Match)."""
+    exact = _KL_REGISTRY.get((type_p, type_q))
+    if exact is not None:
+        return exact
+    best, best_rank = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if not (issubclass(type_p, cp) and issubclass(type_q, cq)):
+            continue
+        rank = (type_p.__mro__.index(cp), type_q.__mro__.index(cq))
+        if best_rank is None or rank < best_rank:
+            best, best_rank = fn, rank
+    return best
+
+
 def kl_divergence(p: Distribution, q: Distribution):
     """reference: python/paddle/distribution/kl.py."""
-    fn = _KL_REGISTRY.get((type(p), type(q)))
+    fn = _dispatch_kl(type(p), type(q))
     if fn is not None:
         return fn(p, q)
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        var_ratio = jnp.square(p.scale / q.scale)
-        t1 = jnp.square((p.loc - q.loc) / q.scale)
-        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        lp, lq = p._log_pmf(), q._log_pmf()
-        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
-    if isinstance(p, Uniform) and isinstance(q, Uniform):
-        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
-    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
-        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-        return Tensor(pp * jnp.log(pp / qq)
-                      + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
-    if isinstance(p, Beta) and isinstance(q, Beta):
-        gl = jax.scipy.special.gammaln
-        dg = jax.scipy.special.digamma
-        pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
-        return Tensor(
-            gl(pa + pb) - gl(pa) - gl(pb) - gl(qa + qb) + gl(qa) + gl(qb)
-            + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
-            + (qa - pa + qb - pb) * dg(pa + pb))
     # same-family exponential-family pairs fall back to the Bregman form
     # (reference kl.py dispatch order)
     from .exponential_family import ExponentialFamily as _EF
@@ -418,6 +416,50 @@ def kl_divergence(p: Distribution, q: Distribution):
             pass
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+# Built-in analytic KLs are REGISTERED (reference kl.py does the same)
+# rather than hidden behind isinstance checks after dispatch fails: the
+# subclass-resolving _dispatch_kl ranks by MRO distance, so e.g. a broad
+# user registration like (Distribution, Distribution) can never shadow
+# the exact Normal/Normal analytic form, and Normal SUBCLASSES still
+# dispatch here unless the user registers something more specific.
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp, lq = p._log_pmf(), q._log_pmf()
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * jnp.log(pp / qq)
+                  + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    return Tensor(
+        gl(pa + pb) - gl(pa) - gl(pb) - gl(qa + qb) + gl(qa) + gl(qb)
+        + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+        + (qa - pa + qb - pb) * dg(pa + pb))
 
 
 # -- round-5 additions: transforms / wrappers (reference transform.py:59,

@@ -5,4 +5,5 @@ The TPU-native replacement for the reference's hand-fused CUDA kernels
 optimizer updates.  Every kernel has an XLA fallback so the framework runs
 anywhere jax runs; kernels self-gate via their ``supported()`` predicates.
 """
+from . import autotune  # noqa: F401
 from . import flash_attention  # noqa: F401

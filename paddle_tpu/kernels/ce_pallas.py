@@ -24,6 +24,7 @@ from jax.experimental import pallas as pl
 
 from ..core.dtype import x64_scope
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+from .pallas_compat import CompilerParams
 
 DEFAULT_BLOCK_ROWS = 8
 
@@ -175,6 +176,15 @@ def lse_supported(n_rows: int, vocab: int, itemsize: int = 2) -> bool:
     return _lse_layout(n_rows, vocab, itemsize)[0] > 0
 
 
+def _valid_lse_cfg(n, v, rb, cc) -> bool:
+    """Shared (row_block, chunk) validity predicate: used by BOTH the
+    candidate generator and _lse_call's dispatch validator so a tuned
+    winner can never pass one and silently fail the other."""
+    return (isinstance(rb, int) and isinstance(cc, int) and rb > 0
+            and cc >= 128 and cc % 128 == 0 and n % rb == 0
+            and v % cc == 0)
+
+
 def _lse_kernel(x_ref, lse_ref, m_sc, l_sc, *, nv):
     vi = jax.lax.convert_element_type(pl.program_id(1), jnp.int32)
 
@@ -199,9 +209,8 @@ def _lse_kernel(x_ref, lse_ref, m_sc, l_sc, *, nv):
                         / jnp.float32(_LOG2E))[:, None]
 
 
-def _lse_call(x2, interpret):
+def _lse_call_cfg(x2, br, c, interpret):
     n, v = x2.shape
-    br, c = _lse_layout(n, v, x2.dtype.itemsize)
     nv = v // c
     return pl.pallas_call(
         functools.partial(_lse_kernel, nv=nv),
@@ -211,10 +220,99 @@ def _lse_call(x2, interpret):
         out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
         scratch_shapes=[pltpu.VMEM((br,), jnp.float32),
                         pltpu.VMEM((br,), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x2)
+
+
+def autotune_key(n, v, dtype):
+    from . import autotune as at
+    return {"n": int(n), "v": int(v), "dtype": str(jnp.dtype(dtype)),
+            "platform": at.platform()}
+
+
+def _lse_candidates(key):
+    """ce_lse autotune family: (row_block, vocab_chunk) tile layouts.
+    Candidate [0] is exactly what _lse_layout hand-picks today; the rest
+    are every admissible row block with its largest chunk plus a
+    half-sized chunk (more grid steps, smaller working set)."""
+    n, v = key["n"], key["v"]
+    itemsize = jnp.dtype(key["dtype"]).itemsize
+    br0, c0 = _lse_layout(n, v, itemsize)
+    cands = []
+    if br0:
+        cands.append({"variant": "base",
+                      "config": {"block_rows": br0, "chunk": c0}})
+    for br in (256, 128, 64, 32, 16, 8):
+        if n % br:
+            continue
+        c = _lse_chunk(v, br, itemsize)
+        if not c:
+            continue
+        for cc in (c, c // 2):
+            if _valid_lse_cfg(n, v, br, cc):
+                cand = {"variant": "base",
+                        "config": {"block_rows": br, "chunk": cc}}
+                if cand not in cands:
+                    cands.append(cand)
+    return cands
+
+
+#: per-key synthetic logits shared across the candidates of one tune()
+#: run (the bench key is ~1.6 GB — regenerating + re-transferring it per
+#: candidate would dominate warm time); freed by the cleanup hook
+_LSE_RUNNER_DATA: dict = {}
+
+
+def _lse_runner(cand, key):
+    import numpy as np
+    from . import autotune as at
+    cfg = cand["config"]
+    n, v = key["n"], key["v"]
+    interpret = key["platform"] != "tpu"
+    ks = at.key_str(key)
+    x2 = _LSE_RUNNER_DATA.get(ks)
+    if x2 is None:
+        x2 = jnp.asarray(
+            np.random.RandomState(0).standard_normal((n, v)),
+            jnp.dtype(key["dtype"]))
+        _LSE_RUNNER_DATA[ks] = x2
+
+    def timed(x):
+        # same x64-off trace scope as the production entry
+        # (logsumexp_pallas) — see flash_attention_pallas._bwd_runner
+        with x64_scope(False):
+            return _lse_call_cfg(x, cfg["block_rows"], cfg["chunk"],
+                                 interpret)
+    fn = jax.jit(timed)
+
+    def run():
+        jax.block_until_ready(fn(x2))
+    return run
+
+
+def _lse_runner_cleanup(key):
+    from . import autotune as at
+    _LSE_RUNNER_DATA.pop(at.key_str(key), None)
+
+
+def _lse_register():
+    from . import autotune as at
+    at.register_family("ce_lse", _lse_candidates, _lse_runner,
+                       cleanup=_lse_runner_cleanup)
+
+
+def _lse_call(x2, interpret):
+    n, v = x2.shape
+    br, c = _lse_layout(n, v, x2.dtype.itemsize)
+    from . import autotune as at
+    cand = at.resolve("ce_lse", autotune_key(n, v, x2.dtype))
+    cfg = cand.get("config", {})
+    rb, cc = cfg.get("block_rows"), cfg.get("chunk")
+    if _valid_lse_cfg(n, v, rb, cc):
+        br, c = rb, cc      # tuned/pinned layout (validated; bad cache
+    return _lse_call_cfg(x2, br, c, interpret)  # entries fall back)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -242,3 +340,6 @@ def _lse_vjp_bwd(interpret, res, g):
 
 
 logsumexp_pallas.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
+
+
+_lse_register()

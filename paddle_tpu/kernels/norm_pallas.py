@@ -24,8 +24,87 @@ from jax.experimental import pallas as pl
 
 from ..core.dtype import x64_scope
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+from .pallas_compat import CompilerParams
 
 DEFAULT_BLOCK_ROWS = 256
+
+
+def _shrink_rows(block_rows, n):
+    """The hand-tuned row-block policy: shrink the default to the largest
+    power-of-two divisor of n (floor 8)."""
+    br = min(block_rows, n)
+    while br > 8 and n % br:
+        br //= 2
+    return br
+
+
+def autotune_key(n, f, dtype):
+    from . import autotune as at
+    return {"n": int(n), "f": int(f), "dtype": str(jnp.dtype(dtype)),
+            "platform": at.platform()}
+
+
+def _ln_candidates(key):
+    """ln autotune family: the row-block size of the LayerNorm grid.
+    Candidate [0] is the hand-tuned _shrink_rows default."""
+    n = key["n"]
+    br0 = _shrink_rows(DEFAULT_BLOCK_ROWS, n)
+    cands = [{"variant": "base", "config": {"block_rows": br0}}]
+    for br in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if br != br0 and br <= n and n % br == 0:
+            cands.append({"variant": "base", "config": {"block_rows": br}})
+    return cands
+
+
+#: per-key synthetic operands shared across one tune() run's candidates
+#: (see ce_pallas._LSE_RUNNER_DATA); freed by the cleanup hook
+_LN_RUNNER_DATA: dict = {}
+
+
+def _ln_runner(cand, key):
+    import numpy as np
+    from . import autotune as at
+    n, f = key["n"], key["f"]
+    dtype = jnp.dtype(key["dtype"])
+    interpret = key["platform"] != "tpu"
+    br = cand["config"]["block_rows"]
+    ks = at.key_str(key)
+    data = _LN_RUNNER_DATA.get(ks)
+    if data is None:
+        rng = np.random.RandomState(0)
+        data = (jnp.asarray(rng.standard_normal((n, f)), dtype),
+                jnp.ones((f,), dtype), jnp.zeros((f,), dtype))
+        _LN_RUNNER_DATA[ks] = data
+    x2, gamma, beta = data
+
+    def timed(x, g, b):
+        # same x64-off trace scope as the production entry (_ln_core)
+        with x64_scope(False):
+            return _ln_fwd(x, g, b, 1e-5, br, interpret)
+    fn = jax.jit(timed)
+
+    def run():
+        jax.block_until_ready(fn(x2, gamma, beta))
+    return run
+
+
+def _ln_runner_cleanup(key):
+    from . import autotune as at
+    _LN_RUNNER_DATA.pop(at.key_str(key), None)
+
+
+def _ln_resolve_rows(n, f, dtype, block_rows):
+    """Row-block pick for one call: explicit non-default block_rows is
+    honored as-is; the default resolves through the autotuner (returning
+    the hand-tuned shrink unless a tuned/pinned config exists)."""
+    if block_rows != DEFAULT_BLOCK_ROWS:
+        return _shrink_rows(block_rows, n)
+    from . import autotune as at
+    cand = at.resolve("ln", autotune_key(n, f, dtype))
+    br = cand.get("config", {}).get("block_rows")
+    if isinstance(br, int) and 8 <= br <= n and n % br == 0:
+        return br
+    return _shrink_rows(block_rows, n)
 
 
 def _supported_feature_dim(f: int) -> bool:
@@ -122,7 +201,7 @@ def _ln_bwd(x2, gamma, mean, rstd, do2, block_rows, interpret):
             jax.ShapeDtypeStruct((f,), jnp.float32),
             jax.ShapeDtypeStruct((f,), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x2, gamma, mean, rstd, do2)
@@ -142,9 +221,7 @@ def _ln_core(x, gamma, beta, eps, block_rows, interpret):
     f = x.shape[-1]
     x2 = x.reshape(-1, f)
     n = x2.shape[0]
-    br = min(block_rows, n)
-    while br > 8 and n % br:
-        br //= 2
+    br = _ln_resolve_rows(n, f, x.dtype, block_rows)
     if n % br or not _supported_feature_dim(f):
         raise ValueError(
             f"layer_norm_pallas: shape ({n}, {f}) not tileable "
@@ -164,9 +241,8 @@ def _ln_vjp_bwd(eps, block_rows, interpret, res, g):
     f = x.shape[-1]
     x2 = x.reshape(-1, f)
     n = x2.shape[0]
-    br = min(block_rows, n)
-    while br > 8 and n % br:
-        br //= 2
+    # same deterministic pick as the forward (memoised, so fwd/bwd agree)
+    br = _ln_resolve_rows(n, f, x.dtype, block_rows)
     with x64_scope(False):
         dx, dg, db = _ln_bwd(x2, gamma, mean, rstd, g.reshape(-1, f), br,
                              interpret)
@@ -212,3 +288,12 @@ def softmax_pallas(x, block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
             interpret=interpret,
         )(x2)
     return out.reshape(x.shape)
+
+
+def _ln_register():
+    from . import autotune as at
+    at.register_family("ln", _ln_candidates, _ln_runner,
+                       cleanup=_ln_runner_cleanup)
+
+
+_ln_register()

@@ -1,0 +1,17 @@
+"""Pallas API drift shims shared by the kernel modules.
+
+The kernels are written against the current Pallas surface; the CI/test
+environment pins jax 0.4.37 (see .github/workflows/ci.yml), where
+``pltpu.CompilerParams`` is still spelled ``TPUCompilerParams``.  Resolving
+the name here keeps every kernel importable (and interpret-mode testable)
+on both — this single missing attribute used to fail COLLECTION of the
+whole kernel test set under the pin.
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
+__all__ = ["CompilerParams"]

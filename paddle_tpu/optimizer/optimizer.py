@@ -25,6 +25,22 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 
+def _path_name(key_path) -> str:
+    """Dotted leaf name from a jax key path for apply_decay_param_fun:
+    DictKey exposes .key, GetAttrKey .name, SequenceKey .idx — str() of
+    the entry itself would prepend separators ('.w', '[0]') and produce
+    mangled names like 'layer1..w'."""
+    parts = []
+    for k in key_path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=None):
@@ -72,8 +88,26 @@ class Optimizer:
             slots["master"] = p.astype(jnp.float32)
         return slots
 
-    def _update_leaf(self, g, p, slots, lr, step):
-        """update_one, routed through the fp32 master copy when present."""
+    def _update_leaf(self, g, p, slots, lr, step, name=None):
+        """update_one, routed through the fp32 master copy when present.
+
+        ``name`` enables AdamW's ``apply_decay_param_fun`` (reference
+        adamw.py:54): parameters the predicate rejects update with weight
+        decay OFF.  The toggle is a host-side flip of self._wd around the
+        (trace-time) update_one call, so each leaf bakes its own decay
+        constant without widening the update_one subclass API; it assumes
+        the standard single-threaded trace — concurrently tracing the
+        SAME optimizer object from multiple threads could observe the
+        flipped value."""
+        fn = getattr(self, "_apply_decay_param_fun", None)
+        if fn is not None and name is not None and self._wd \
+                and not fn(name):
+            saved = self._wd
+            self._wd = 0.0
+            try:
+                return self._update_leaf(g, p, slots, lr, step)
+            finally:
+                self._wd = saved
         master = slots.get("master") if isinstance(slots, dict) else None
         if master is None:
             return self.update_one(g, p, slots, lr, step)
@@ -151,13 +185,19 @@ class Optimizer:
         # grad clip first (global norm across the whole tree)
         g_leaves = self._clip_tree(p_leaves, g_leaves)
         slot_leaves = _flatten_slots(state["slots"], treedef, len(p_leaves))
+        names = [None] * len(p_leaves)
+        if getattr(self, "_apply_decay_param_fun", None) is not None:
+            # leaf names for the per-name decay filter — same traversal
+            # order as tree_flatten
+            paths = jax.tree_util.tree_flatten_with_path(params_tree)[0]
+            names = [_path_name(kp) for kp, _ in paths]
         new_p, new_slots = [], []
-        for p, g, s in zip(p_leaves, g_leaves, slot_leaves):
+        for p, g, s, nm in zip(p_leaves, g_leaves, slot_leaves, names):
             if g is None:
                 new_p.append(p)
                 new_slots.append(s)
                 continue
-            np_, ns = self._update_leaf(g, p, s, lr, step)
+            np_, ns = self._update_leaf(g, p, s, lr, step, name=nm)
             new_p.append(np_)
             new_slots.append(ns)
         params_out = jax.tree_util.tree_unflatten(treedef, new_p)
@@ -216,12 +256,17 @@ class Optimizer:
             flags = [bool(getattr(p, "is_distributed", False))
                      for p in params]
 
+            # host-side constants for the per-name decay filter
+            # (apply_decay_param_fun); baked into the jitted update
+            names = [getattr(p, "name", None) for p in params]
+
             def _update(p_arrs, g_arrs, slot_list, lr, step):
                 g_arrs = self._clip_tree(p_arrs, list(g_arrs),
                                          dist_flags=flags)
                 new_p, new_s = [], []
-                for p, g, s in zip(p_arrs, g_arrs, slot_list):
-                    np_, ns = self._update_leaf(g, p, s, lr, step)
+                for p, g, s, nm in zip(p_arrs, g_arrs, slot_list, names):
+                    np_, ns = self._update_leaf(g, p, s, lr, step,
+                                                name=nm)
                     new_p.append(np_)
                     new_s.append(ns)
                 return new_p, new_s

@@ -133,6 +133,13 @@ class AdamW(Adam):
                          weight_decay, grad_clip, lazy_mode, name,
                          multi_precision, amsgrad, moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
+        if self._wd_mode == "l1":
+            # AdamW's decoupled update p *= (1 - lr*wd) is L2-SHAPED — an
+            # L1Decay coefficient used to be silently applied as L2.  L1
+            # has no decoupled analogue here, so route it through the
+            # coupled wd*sign(p) gradient term instead (instance override
+            # of the class-level _decoupled_wd; _wd_grad then applies it).
+            self._decoupled_wd = False
 
 
 class Adagrad(Optimizer):

@@ -179,7 +179,16 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
           center=True, normalized=False, onesided=True, length=None,
           return_complex=False, name=None):
     """Inverse STFT (least-squares / NOLA-weighted overlap-add) — reference
-    signal.py:391 semantics incl. the NOLA constraint check."""
+    signal.py:391 semantics incl. the NOLA constraint check.
+
+    jit-time caveat: the NOLA (Nonzero Overlap-Add) violation check is a
+    HOST-side ValueError and can only run on concrete values.  Under
+    jit/trace the envelope is a tracer, so the check is skipped; the
+    division is instead guarded with ``jnp.where(envelope > eps, ...)``
+    so a traced NOLA violation yields the un-normalized overlap-add in the
+    near-zero bins rather than silently emitting inf/nan.  Call once
+    eagerly (or run scipy.signal.check_NOLA) to validate a new window/hop
+    configuration before jitting."""
     if x.ndim not in (2, 3):
         raise ValueError("x should be a 2D or 3D complex tensor, but got "
                          f"rank of x is {x.ndim}")
@@ -247,7 +256,13 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
                 "Abort istft because Nonzero Overlap Add (NOLA) condition "
                 "failed. For more information about NOLA constraint please "
                 "see scipy.signal.check_NOLA.")
-    out = out / envelop.astype(out.dtype)
+    # traced-safe division: under jit the host-side NOLA check above cannot
+    # run, and dividing by a ~0 envelope bin would silently emit inf/nan
+    # into the output — guard with a where (envelope = sum(win^2) >= 0, so
+    # the eps compare matches the eager check's threshold; see docstring)
+    envelop_safe = jnp.where(envelop > 1e-11, envelop,
+                             jnp.ones_like(envelop))
+    out = out / envelop_safe.astype(out.dtype)
     if x_rank == 2:
         out = out[0]
     return out

@@ -75,6 +75,17 @@ define_flag("use_pallas_lse", False,
             "consistency with use_pallas_ce: a wash does not earn a "
             "brand-new kernel the default single-device CE path "
             "(ADVICE r4)")
+define_flag("autotune", False,
+            "time kernel variant/config candidates on first call per "
+            "(shape, dtype, platform) key and pick the fastest "
+            "(kernels/autotune.py); off = hand-tuned defaults / cached "
+            "picks only.  Also settable via PADDLE_TPU_AUTOTUNE=1")
+define_flag("autotune_samples", 5,
+            "timing samples per autotune candidate (median is taken)")
+define_flag("autotune_pin", "",
+            "pin autotune candidates: 'family=variant[:k=v,...];...' — "
+            "e.g. 'flash_fwd=bf16chain+iotafree:block_q=256'; wins over "
+            "cache and tuning (env: PADDLE_TPU_AUTOTUNE_PIN)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("allocator_strategy", "xla", "memory allocator (XLA BFC)")

@@ -1,0 +1,20 @@
+"""Shared jax-version compat shims for the test suite.
+
+The CI pin is jax 0.4.37 (see .github/workflows/ci.yml), where shard_map
+lives only under jax.experimental and its vma-checker kwarg is still
+called ``check_rep`` (newer jax: ``from jax import shard_map`` with
+``check_vma``).  One shim here instead of per-file copies that would
+silently diverge.
+"""
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:
+    import functools as _ft
+
+    from jax.experimental.shard_map import shard_map as _shard_map_expm
+
+    @_ft.wraps(_shard_map_expm)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_expm(*args, **kwargs)

@@ -1,0 +1,203 @@
+"""Interpret-mode parity tests for the round-6 flash-attention variants
+(bf16chain / iotafree / parq / pipelined — flash_attention_pallas.py) vs
+the O(S^2) XLA reference, forward AND backward, causal and non-causal,
+including odd-tail shapes and the streamed / split-backward paths."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.kernels.flash_attention_pallas as fap
+from paddle_tpu.kernels.flash_attention_pallas import (
+    _reference_bhsd, flash_attention_bhsd)
+
+#: every selectable forward variant (bwd strips parq/pipelined)
+VARIANTS = ["iotafree", "bf16chain", "bf16chain+iotafree", "parq",
+            "pipelined", "iotafree+pipelined"]
+#: (b, h, s, d) — 384 is the odd-tail shape (not a multiple of the 512
+#: default block: _prep_blocks shrinks to 128), 128-d hits the wide-head
+#: lane layout
+SHAPES = [(1, 2, 256, 64), (1, 2, 384, 64), (2, 1, 256, 128)]
+
+
+def _tol(variant):
+    # bf16chain truncates the softmax chain to bf16 (~2^-8 relative on p)
+    if "bf16chain" in variant:
+        return dict(atol=3e-2, rtol=3e-2)
+    return dict(atol=1e-5, rtol=1e-5)
+
+
+def _qkv(shape, seed=0):
+    b, h, s, d = shape
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(b, h, s, d), jnp.float32),
+            jnp.asarray(rng.randn(b, h, s, d), jnp.float32),
+            jnp.asarray(rng.randn(b, h, s, d), jnp.float32))
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", SHAPES)
+def test_variant_forward_matches_reference(variant, causal, shape):
+    q, k, v = _qkv(shape)
+    d = shape[-1]
+    out = flash_attention_bhsd(q, k, v, causal=causal, interpret=True,
+                               variant=variant)
+    ref = _reference_bhsd(q, k, v, causal, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               **_tol(variant))
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+@pytest.mark.parametrize("causal", [False, True])
+def test_variant_backward_matches_reference(variant, causal):
+    b, h, s, d = 1, 2, 256, 64
+    q, k, v = _qkv((b, h, s, d), seed=1)
+
+    def f(q_, k_, v_):
+        return jnp.sum(jnp.sin(flash_attention_bhsd(
+            q_, k_, v_, causal=causal, interpret=True, variant=variant)))
+
+    def r(q_, k_, v_):
+        return jnp.sum(jnp.sin(_reference_bhsd(q_, k_, v_, causal,
+                                               1.0 / d ** 0.5)))
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    if "bf16chain" in variant:
+        tol = dict(atol=5e-2, rtol=5e-2)
+    else:
+        tol = dict(atol=2e-4, rtol=1e-3)
+    for name, a, b_ in zip("dq dk dv".split(), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   err_msg="%s/%s" % (variant, name),
+                                   **tol)
+
+
+@pytest.mark.parametrize("variant", ["iotafree", "bf16chain"])
+def test_variant_streamed_long_seq_path(variant):
+    """Variants must also hold on the grid-streamed forward (taken when
+    K/V exceed the resident VMEM budget)."""
+    b, h, s, d = 1, 2, 512, 64
+    q, k, v = _qkv((b, h, s, d), seed=5)
+    old = fap._RESIDENT_KV_BUDGET
+    fap._RESIDENT_KV_BUDGET = 1
+    try:
+        out = flash_attention_bhsd(q, k, v, causal=True, interpret=True,
+                                   variant=variant)
+    finally:
+        fap._RESIDENT_KV_BUDGET = old
+    ref = _reference_bhsd(q, k, v, True, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               **_tol(variant))
+
+
+def test_pipelined_ignores_resident_budget():
+    """The pipelined forward streams K/V chunks itself (O(block_k) VMEM)
+    — it must produce reference numerics regardless of the resident
+    budget the other paths dispatch on."""
+    b, h, s, d = 1, 2, 512, 64
+    q, k, v = _qkv((b, h, s, d), seed=6)
+    ref = _reference_bhsd(q, k, v, True, 1.0 / d ** 0.5)
+    old = fap._RESIDENT_KV_BUDGET
+    for budget in (1, old):
+        fap._RESIDENT_KV_BUDGET = budget
+        try:
+            out = flash_attention_bhsd(q, k, v, causal=True,
+                                       interpret=True,
+                                       variant="pipelined")
+        finally:
+            fap._RESIDENT_KV_BUDGET = old
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("variant", ["iotafree", "bf16chain+iotafree"])
+def test_variant_split_backward_parity(variant):
+    """Variant kernels on the SPLIT two-kernel backward (forced via a tiny
+    dq-scratch budget) must match the variant's merged-backward grads."""
+    b, s, h, d = 1, 1024, 2, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.2
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.2
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.2
+    ct = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.1
+
+    def loss(q, k, v, budget):
+        old = fap._DQ_SCRATCH_BUDGET
+        fap._DQ_SCRATCH_BUDGET = budget
+        try:
+            out = fap.flash_attention_bshd_native(
+                q, k, v, causal=True, block_q=256, block_k=256,
+                interpret=True, variant=variant)
+        finally:
+            fap._DQ_SCRATCH_BUDGET = old
+        return jnp.sum(out * ct)
+
+    g_merged = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, 4 * 1024 * 1024)
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, 64 * 1024)
+    for gm, gs, name in zip(g_merged, g_split, "qkv"):
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gm),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("variant", ["iotafree", "parq"])
+def test_variant_with_lse_grads(variant):
+    """flash_attention_bshd_with_lse under a variant: the (out, lse) pair
+    and the lse-cotangent backward stay reference-exact."""
+    from paddle_tpu.kernels.flash_attention_pallas import \
+        flash_attention_bshd_with_lse
+
+    b, s, h, d = 1, 256, 2, 64
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_flash(q_, k_, v_):
+        out, lse = flash_attention_bshd_with_lse(
+            q_, k_, v_, causal=True, interpret=True, variant=variant)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q_, k_, v_):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q_, k_) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v_)
+        lse = jnp.moveaxis(jax.scipy.special.logsumexp(logits, -1), 1, -1)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_iotafree_band_mask_bit_exact():
+    """iotafree is a pure mask-arithmetic rewrite — its output must be
+    BIT-identical to base (same where/select semantics), not just close."""
+    q, k, v = _qkv((1, 2, 256, 64), seed=7)
+    base = flash_attention_bhsd(q, k, v, causal=True, interpret=True,
+                                variant="base")
+    iof = flash_attention_bhsd(q, k, v, causal=True, interpret=True,
+                               variant="iotafree")
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(iof))
+
+
+def test_cross_attention_kv_longer(variantless=True):
+    """sk != s (cross attention, non-causal) through the variant plumbing."""
+    b, h, s, sk, d = 1, 2, 128, 256, 64
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    ref = _reference_bhsd(q, k, v, False, 1.0 / d ** 0.5)
+    for variant in ("base", "iotafree", "pipelined"):
+        out = flash_attention_bhsd(q, k, v, causal=False, interpret=True,
+                                   variant=variant)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=variant)

@@ -451,7 +451,7 @@ def test_embed_grad_shard_exact_parity(monkeypatch):
     is the only place the collective path executes.)"""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from _jax_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from paddle_tpu.distributed import pipeline as pipe_mod

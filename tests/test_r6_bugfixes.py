@@ -1,0 +1,316 @@
+"""Round-6 advisor bugfix regressions: fp16 finite-check overflow,
+register_kl subclass dispatch, AdamW(weight_decay=L1Decay) routing, and
+istft's NOLA envelope division under trace."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# 1. fp16 finite-check: running max(|g|) instead of a global |g|-sum
+# ---------------------------------------------------------------------------
+
+def test_grads_finite_large_but_finite_does_not_overflow():
+    """A large-but-finite gradient set must NOT be flagged as overflow:
+    the old global |g|-SUM overflowed f32 to inf (silently skipping the
+    step); the running max(|g|) cannot."""
+    from paddle_tpu.distributed.pipeline import _grads_finite
+
+    big = jnp.full((8, 8), 1e38, jnp.float32)
+    grads = {"a": big, "b": big, "c": big}
+    # the bug this regresses: the per-leaf SUM total is inf for these
+    total = jnp.float32(0.0)
+    for g in jax.tree_util.tree_leaves(grads):
+        total = total + jnp.sum(jnp.abs(g))
+    assert not bool(jnp.isfinite(total))
+    # the shipped max-based check keeps the step
+    assert bool(_grads_finite(grads))
+
+
+@pytest.mark.parametrize("poison", [jnp.inf, -jnp.inf, jnp.nan])
+@pytest.mark.parametrize("pos", [0, 1, 2])
+def test_grads_finite_still_catches_nonfinite(poison, pos):
+    from paddle_tpu.distributed.pipeline import _grads_finite
+
+    leaves = [jnp.ones((4,), jnp.float32) for _ in range(3)]
+    leaves[pos] = leaves[pos].at[1].set(poison)
+    assert not bool(_grads_finite({"l%d" % i: g
+                                   for i, g in enumerate(leaves)}))
+
+
+def test_grads_finite_zero_size_leaf():
+    """max has no identity for empty arrays — a 0-element leaf (empty
+    bias, degenerate shard) must be skipped, not crash the trace (the
+    sum-based check returned 0.0 for such leaves)."""
+    from paddle_tpu.distributed.pipeline import _grads_finite
+
+    assert bool(_grads_finite({"a": jnp.ones((4,), jnp.float32),
+                               "empty": jnp.zeros((0,), jnp.float32)}))
+    assert not bool(_grads_finite(
+        {"empty": jnp.zeros((0, 3), jnp.float32),
+         "bad": jnp.array([jnp.nan], jnp.float32)}))
+
+
+def test_grads_finite_scalar_and_fp16_leaves():
+    from paddle_tpu.distributed.pipeline import _grads_finite
+
+    assert bool(_grads_finite({"s": jnp.float32(3.0),
+                               "h": jnp.ones((2,), jnp.float16) * 60000}))
+    assert not bool(_grads_finite({"h": jnp.array([jnp.inf], jnp.float16)}))
+
+
+# ---------------------------------------------------------------------------
+# 2. register_kl resolves subclasses (most-specific ancestor pair)
+# ---------------------------------------------------------------------------
+
+def test_register_kl_resolves_subclasses():
+    from paddle_tpu.distribution import (Distribution, kl_divergence,
+                                         register_kl)
+    from paddle_tpu.distribution import __init__ as _  # noqa: F401
+    import paddle_tpu.distribution as dist_mod
+
+    class Base(Distribution):
+        def __init__(self):
+            pass
+
+    class Child(Base):
+        pass
+
+    class GrandChild(Child):
+        pass
+
+    added = []
+    try:
+        @register_kl(Base, Base)
+        def _kl_base(p, q):
+            return "base-base"
+        added.append((Base, Base))
+
+        @register_kl(Child, Base)
+        def _kl_child(p, q):
+            return "child-base"
+        added.append((Child, Base))
+
+        # exact pair still wins
+        assert kl_divergence(Base(), Base()) == "base-base"
+        # SUBCLASS instances dispatch to the most-specific ancestor pair
+        # (the old exact-type lookup raised NotImplementedError here)
+        assert kl_divergence(GrandChild(), GrandChild()) == "child-base"
+        assert kl_divergence(Child(), Child()) == "child-base"
+        # left argument is more specific -> (Child, Base) beats (Base, Base)
+        assert kl_divergence(Child(), Base()) == "child-base"
+        assert kl_divergence(Base(), Child()) == "base-base"
+    finally:
+        for k in added:
+            dist_mod._KL_REGISTRY.pop(k, None)
+
+
+def test_register_kl_broad_registration_cannot_shadow_builtins():
+    """The built-in analytic KLs are registered, so MRO ranking prefers
+    them over a broad user fallback like (Distribution, Distribution) —
+    Normal/Normal must stay exact."""
+    import paddle_tpu.distribution as dist_mod
+    from paddle_tpu.distribution import (Distribution, Normal,
+                                         kl_divergence, register_kl)
+
+    key = (Distribution, Distribution)
+    assert key not in dist_mod._KL_REGISTRY
+
+    @register_kl(Distribution, Distribution)
+    def _kl_mc_fallback(p, q):
+        return "approximate"
+
+    try:
+        got = kl_divergence(Normal(loc=0.0, scale=1.0),
+                            Normal(loc=1.0, scale=2.0))
+        assert not isinstance(got, str)   # analytic Tensor, not fallback
+        np.testing.assert_allclose(
+            np.asarray(got.numpy()),
+            0.5 * (0.25 + 0.25 - 1 - np.log(0.25)), rtol=1e-6)
+
+        class Opaque(Distribution):
+            def __init__(self):
+                pass
+
+        # ...while genuinely unknown pairs DO reach the fallback
+        assert kl_divergence(Opaque(), Opaque()) == "approximate"
+    finally:
+        dist_mod._KL_REGISTRY.pop(key, None)
+
+
+def test_register_kl_unrelated_still_raises():
+    from paddle_tpu.distribution import Distribution, kl_divergence
+
+    class Lonely(Distribution):
+        def __init__(self):
+            pass
+
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Lonely(), Lonely())
+
+
+# ---------------------------------------------------------------------------
+# 3. AdamW(weight_decay=L1Decay) routes through the coupled sign(p) term
+# ---------------------------------------------------------------------------
+
+def test_adamw_l1decay_routes_coupled_not_l2():
+    """AdamW's decoupled update p *= (1 - lr*wd) is L2-shaped; an L1Decay
+    coefficient used to be silently applied that way.  It must now run as
+    coupled wd*sign(p) — i.e. EXACTLY what Adam(weight_decay=L1Decay)
+    does — and differ from the decoupled-L2 AdamW trajectory."""
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.regularizer import L1Decay
+
+    X = paddle.to_tensor(
+        np.random.RandomState(0).rand(16, 8).astype("float32"))
+    Y = paddle.to_tensor(
+        np.random.RandomState(1).rand(16, 4).astype("float32"))
+
+    def run(make_opt):
+        paddle.seed(3)
+        m = nn.Linear(8, 4)
+        opt = make_opt(m)
+        step = TrainStep(m, nn.MSELoss(), opt)
+        for _ in range(3):
+            step(X, Y)
+        step.sync_to_model()   # write trained arrays back into the model
+        return {k: np.asarray(v.numpy()) for k, v in
+                m.state_dict().items()}
+
+    opt_cfg = dict(learning_rate=1e-2)
+    w_adamw_l1 = run(lambda m: paddle.optimizer.AdamW(
+        parameters=m.parameters(), weight_decay=L1Decay(0.1), **opt_cfg))
+    w_adam_l1 = run(lambda m: paddle.optimizer.Adam(
+        parameters=m.parameters(), weight_decay=L1Decay(0.1), **opt_cfg))
+    w_adamw_l2 = run(lambda m: paddle.optimizer.AdamW(
+        parameters=m.parameters(), weight_decay=0.1, **opt_cfg))
+
+    for k in w_adamw_l1:
+        np.testing.assert_allclose(w_adamw_l1[k], w_adam_l1[k],
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+    assert any(np.max(np.abs(w_adamw_l1[k] - w_adamw_l2[k])) > 1e-5
+               for k in w_adamw_l1), \
+        "L1Decay trajectory should differ from decoupled-L2 AdamW"
+
+
+def test_adamw_float_decay_stays_decoupled():
+    from paddle_tpu import nn
+
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                 weight_decay=0.01)
+    assert opt._decoupled_wd is True
+    opt_l1 = paddle.optimizer.AdamW(
+        parameters=m.parameters(),
+        weight_decay=paddle.regularizer.L1Decay(0.01))
+    assert opt_l1._decoupled_wd is False and opt_l1._wd_mode == "l1"
+    # L2Decay objects keep the decoupled path (reference semantics)
+    opt_l2 = paddle.optimizer.AdamW(
+        parameters=m.parameters(),
+        weight_decay=paddle.regularizer.L2Decay(0.01))
+    assert opt_l2._decoupled_wd is True
+
+
+def test_adamw_apply_decay_param_fun_filters_by_name():
+    """apply_decay_param_fun was stored but never consulted — decay
+    applied to every parameter.  Excluded params must now update with
+    weight decay OFF (both the eager step() and the jitted
+    apply_gradients path go through the same _update_leaf filter)."""
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+
+    X = paddle.to_tensor(
+        np.random.RandomState(0).rand(16, 8).astype("float32"))
+    Y = paddle.to_tensor(
+        np.random.RandomState(1).rand(16, 4).astype("float32"))
+
+    def run(**kw):
+        paddle.seed(5)
+        m = nn.Linear(8, 4)
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=1e-2,
+                                     weight_decay=0.5, **kw)
+        step = TrainStep(m, nn.MSELoss(), opt)
+        for _ in range(5):
+            step(X, Y)
+        step.sync_to_model()
+        return {k: np.asarray(v.numpy()) for k, v in
+                m.state_dict().items()}
+
+    w_all = run()
+    w_none = run(apply_decay_param_fun=lambda n: False)
+    w_zero = run()  # determinism control
+    for k in w_all:
+        np.testing.assert_array_equal(w_all[k], w_zero[k], err_msg=k)
+    # with the filter rejecting everything, the trajectory must match
+    # weight_decay=0 — i.e. differ from the decayed run
+    paddle.seed(5)
+    m0 = nn.Linear(8, 4)
+    opt0 = paddle.optimizer.AdamW(parameters=m0.parameters(),
+                                  learning_rate=1e-2, weight_decay=0.0)
+    step0 = TrainStep(m0, nn.MSELoss(), opt0)
+    for _ in range(5):
+        step0(X, Y)
+    step0.sync_to_model()
+    w_nodecay = {k: np.asarray(v.numpy()) for k, v in
+                 m0.state_dict().items()}
+    for k in w_none:
+        np.testing.assert_allclose(w_none[k], w_nodecay[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+    assert any(np.max(np.abs(w_all[k] - w_none[k])) > 1e-4
+               for k in w_all), "filter had no effect"
+
+
+# ---------------------------------------------------------------------------
+# 4. istft: NOLA envelope division guarded under trace
+# ---------------------------------------------------------------------------
+
+def test_istft_traced_nola_violation_stays_finite():
+    """Under jit the host-side NOLA ValueError cannot fire; the guarded
+    division must keep the output finite instead of silently emitting
+    inf/nan (the eager path still raises — test_signal.py covers it)."""
+    from paddle_tpu import signal
+
+    n_fft, hop, frames = 16, 16, 6
+    win = np.zeros(n_fft, np.float32)
+    win[:4] = 1.0          # hop > window support -> NOLA violated
+    spec = (np.random.RandomState(0)
+            .randn(n_fft // 2 + 1, frames).astype(np.float32)
+            + 1j * np.random.RandomState(1)
+            .randn(n_fft // 2 + 1, frames).astype(np.float32))
+    x = paddle.to_tensor(spec.astype(np.complex64))
+    win_t = paddle.to_tensor(win)
+
+    # eager: the NOLA check still raises on concrete values
+    with pytest.raises(ValueError, match="NOLA"):
+        signal.istft(x, n_fft=n_fft, hop_length=hop, window=win_t,
+                     center=False)
+
+    @jax.jit
+    def traced(arr):
+        return signal.istft(paddle.Tensor(arr), n_fft=n_fft,
+                            hop_length=hop, window=win_t,
+                            center=False)._array
+
+    out = traced(x._array)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_istft_guard_preserves_valid_roundtrip():
+    """The where-guard must not perturb a NOLA-satisfying reconstruction
+    (envelope bins > eps divide exactly as before)."""
+    from paddle_tpu import signal
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(1024)
+    n_fft, hop = 256, 64
+    win = paddle.to_tensor(np.hanning(n_fft), dtype="float64")
+    xt = paddle.to_tensor(x, dtype="float64")
+    y = signal.stft(xt, n_fft=n_fft, hop_length=hop, window=win)
+    back = signal.istft(y, n_fft=n_fft, hop_length=hop, window=win,
+                        length=1024)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-8, atol=1e-8)
