@@ -17,6 +17,12 @@ TPU301   collectives         collective axis names match declared mesh axes
 TPU401   schema_drift        ops_schema.yaml matches the live op surface
 =======  ==================  ==============================================
 
+A second tier — tpu-audit, TPU5xx — lives in :mod:`.trace` and runs over
+the *traced programs* (jaxprs + lowered StableHLO) of the canonical
+program registry instead of source text:
+``python -m paddle_tpu.analysis --trace --strict``.  See the trace
+package docstring for the TPU501-505 catalogue.
+
 Programmatic use::
 
     from paddle_tpu.analysis import Analyzer
@@ -33,6 +39,9 @@ from .x64 import S64_COMPUTE_OPS, X64WideningPass
 from .collectives import CollectiveAxisPass
 from .schema_drift import SchemaDriftPass
 
+from .trace import (TRACE_PASSES, TRACE_RULES, F32_ACCUM_OPS,
+                    TraceAnalyzer, TraceProgram)
+
 #: default pass set, in rule-id order.
 ALL_PASSES = [HostSyncPass, X64WideningPass, CollectiveAxisPass,
               SchemaDriftPass]
@@ -43,4 +52,5 @@ __all__ = ["Analyzer", "FileContext", "Finding", "LintPass", "ProjectPass",
            "Report", "ScopedVisitor", "Baseline", "BaselineEntry",
            "BaselineFormatError", "HostSyncPass", "X64WideningPass",
            "CollectiveAxisPass", "SchemaDriftPass", "ALL_PASSES", "RULES",
-           "S64_COMPUTE_OPS"]
+           "S64_COMPUTE_OPS", "TRACE_PASSES", "TRACE_RULES",
+           "F32_ACCUM_OPS", "TraceAnalyzer", "TraceProgram"]

@@ -1,74 +1,146 @@
-"""CLI for tpu-lint: ``python -m paddle_tpu.analysis [paths] [--strict]``.
+"""CLI for the analysis tiers.
+
+* tpu-lint (AST):   ``python -m paddle_tpu.analysis [paths] [--strict]``
+* tpu-audit (trace): ``python -m paddle_tpu.analysis --trace [programs]
+  [--select TPU504] [--strict]`` — positional args become fnmatch
+  patterns over canonical-program names (``'pallas/*'``).
+
+``--format json`` emits one machine-readable JSON document on stdout;
+``--format github`` emits GitHub workflow annotation lines
+(``::error ...``) per finding so CI surfaces them inline on the PR.
 
 Exit codes: 0 clean (or findings without --strict), 1 findings under
---strict, 2 operational error (unparsable file, bad baseline).
+--strict, 2 operational error (unparsable file, bad baseline, broken
+program builder).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
-from . import ALL_PASSES, RULES, Analyzer
+from . import ALL_PASSES, RULES, TRACE_RULES, Analyzer
 from .baseline import BaselineFormatError
+
+
+def _emit(report, fmt: str, quiet: bool, skipped=()):
+    if fmt == "json":
+        doc = {
+            "ok": report.ok,
+            "files": report.files,
+            "findings": [{
+                "rule": f.rule, "path": f.path, "line": f.line,
+                "col": f.col, "symbol": f.symbol, "message": f.message,
+            } for f in report.findings],
+            "baselined": len(report.baselined),
+            "inline_suppressed": len(report.inline_suppressed),
+            "stale_baseline": list(report.stale_baseline),
+            "errors": list(report.errors),
+            "skipped": list(skipped),
+        }
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return
+    if fmt == "github":
+        for f in report.findings:
+            # %0A is the annotation-format newline escape
+            msg = f.message.replace("%", "%25").replace("\n", "%0A")
+            print("::error file=%s,line=%d,title=%s [%s]::%s"
+                  % (f.path, max(1, f.line), f.rule, f.symbol, msg))
+        for e in report.errors:
+            print("::error title=tpu-lint operational error::%s"
+                  % e.replace("%", "%25").replace("\n", "%0A"))
+    else:
+        for f in report.findings:
+            print(f.format())
+    for s in report.stale_baseline:
+        print(f"warning: stale baseline entry — {s}", file=sys.stderr)
+    for s in skipped:
+        # loud: a skipped builder means the strict gate is auditing FEWER
+        # programs than CI does — usually a missing shell-level
+        # XLA_FLAGS=--xla_force_host_platform_device_count=8 (it must be
+        # set before `import paddle_tpu` initializes the jax backend)
+        print(f"warning: SKIPPED program builder — {s}", file=sys.stderr)
+    for e in report.errors:
+        print(f"error: {e}", file=sys.stderr)
+    if not quiet:
+        print(f"tpu-lint: {report.summary()}", file=sys.stderr)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="tpu-lint — static analysis for the paddle_tpu tree")
-    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
-                    help="files/directories to analyze (default: paddle_tpu)")
+        description="tpu-lint (AST) / tpu-audit (trace) — static analysis "
+                    "for the paddle_tpu tree")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze (default: "
+                         "paddle_tpu); with --trace: fnmatch patterns "
+                         "over canonical program names (default: all)")
     ap.add_argument("--root", default=os.getcwd(),
                     help="repo root for relative paths + baseline "
                          "(default: cwd)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 when any unsuppressed finding remains")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the trace tier (TPU5xx) over the canonical "
+                         "program registry instead of the AST tier")
     ap.add_argument("--baseline", default="auto",
                     help="baseline file (default: "
                          "<root>/tools/tpu_lint_baseline.txt if present); "
                          "'none' disables")
     ap.add_argument("--select", default=None, metavar="RULES",
-                    help="comma-separated rule ids to run "
-                         f"(available: {', '.join(sorted(RULES))})")
+                    help="comma-separated rule ids to run (AST: %s; "
+                         "trace: %s)" % (", ".join(sorted(RULES)),
+                                         ", ".join(sorted(TRACE_RULES))))
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "github"),
+                    help="finding output format (default: text; 'github' "
+                         "emits ::error workflow annotations)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="findings only, no summary")
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rule, cls in sorted(RULES.items()):
+        for rule, cls in sorted(RULES.items()) + sorted(TRACE_RULES.items()):
             print(f"{rule}  {cls.name:<18} {cls.description}")
         return 0
 
-    passes = ALL_PASSES
+    catalogue = TRACE_RULES if args.trace else RULES
+    passes = None
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
-        unknown = wanted - set(RULES)
+        unknown = wanted - set(catalogue)
         if unknown:
             print(f"unknown rules: {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
-        passes = [RULES[r] for r in sorted(wanted)]
+        passes = [catalogue[r] for r in sorted(wanted)]
 
     baseline = None if args.baseline == "none" else args.baseline
+    skipped = ()
     try:
-        analyzer = Analyzer(root=args.root, passes=passes,
-                            baseline_path=baseline)
-        report = analyzer.run(args.paths)
+        if args.trace:
+            from .trace import TraceAnalyzer, build_programs
+            programs, skipped, errors = build_programs(args.paths or None)
+            analyzer = TraceAnalyzer(root=args.root, passes=passes,
+                                     baseline_path=baseline)
+            report = analyzer.run(programs, errors=errors,
+                                  partial=bool(args.paths))
+            if not programs and not errors:
+                report.errors.append(
+                    "trace registry built 0 programs (patterns %r) — an "
+                    "empty audit must not pass" % (args.paths,))
+        else:
+            analyzer = Analyzer(root=args.root, passes=passes,
+                                baseline_path=baseline)
+            report = analyzer.run(args.paths or ["paddle_tpu"])
     except (BaselineFormatError, OSError) as e:
         print(f"tpu-lint: {e}", file=sys.stderr)
         return 2
 
-    for f in report.findings:
-        print(f.format())
-    for s in report.stale_baseline:
-        print(f"warning: stale baseline entry — {s}", file=sys.stderr)
-    for e in report.errors:
-        print(f"error: {e}", file=sys.stderr)
-    if not args.quiet:
-        print(f"tpu-lint: {report.summary()}", file=sys.stderr)
+    _emit(report, args.format, args.quiet, skipped)
 
     if report.errors:
         return 2

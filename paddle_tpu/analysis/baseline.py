@@ -84,6 +84,15 @@ class Baseline:
                                              lineno=lineno))
         return cls(entries)
 
+    def subset(self, pred) -> "Baseline":
+        """Baseline restricted to entries satisfying ``pred`` (entry
+        objects are shared, so 'used' marks survive across subsets).  The
+        AST tier takes the non-TPU5xx entries and the trace tier the
+        TPU5xx ones — each tier's stale report covers only the entries it
+        could ever match, so running one tier never flags the other
+        tier's debt as stale."""
+        return Baseline([e for e in self.entries if pred(e)])
+
     def matches(self, finding) -> bool:
         hit = False
         for e in self.entries:

@@ -269,8 +269,12 @@ class Analyzer:
                                          "tpu_lint_baseline.txt")
             if not os.path.exists(baseline_path):
                 baseline_path = None
-        self.baseline = Baseline.load(baseline_path) if baseline_path \
+        base = Baseline.load(baseline_path) if baseline_path \
             else Baseline([])
+        # TPU5xx entries belong to the trace tier (analysis.trace) —
+        # excluded here so they are never reported stale by an AST run
+        self.baseline = base.subset(
+            lambda e: not e.rule.startswith("TPU5"))
 
     def run(self, paths: Sequence[str]) -> Report:
         report = Report([], [], [], [], [])

@@ -1,0 +1,54 @@
+"""paddle_tpu.analysis.trace — tpu-audit, the jaxpr/StableHLO tier.
+
+Second tier of the analysis framework: where tpu-lint (TPU1xx-4xx) walks
+Python ASTs, this tier walks the **traced program** — jaxprs and lowered
+StableHLO of the canonical-program registry (:mod:`.programs`) — and
+enforces the invariants source text cannot show.
+
+=======  =================  =============================================
+rule     pass               invariant
+=======  =================  =============================================
+TPU501   dtype_leak         bf16-region f32 upcasts feed only the shared
+                            statistics/accumulator allowlist
+TPU502   donation           declared donate_argnums materialize as
+                            input-output aliasing in the lowered entry
+TPU503   collective_order   identical collective sequence on all cond
+                            branches; collective axes declared with
+                            consistent sizes; ppermute perms in range
+TPU504   vmem_budget        Pallas BlockSpec working set fits per-core
+                            VMEM (also gates autotune candidates
+                            pre-compile)
+TPU505   purity             no dead/duplicated expensive subcomputation,
+                            no stray host callbacks
+=======  =================  =============================================
+
+CLI: ``python -m paddle_tpu.analysis --trace [--select TPU504] --strict``.
+Baseline entries share ``tools/tpu_lint_baseline.txt`` keyed on
+``(rule, program, op-path)``.
+"""
+from .core import (EqnSite, TraceAnalyzer, TracePass, TraceProgram,
+                   op_paths, subjaxprs, walk_eqns)
+from .dtype_leak import F32_ACCUM_OPS, DtypeLeakPass
+from .donation import DonationPass
+from .collective_order import COLLECTIVE_PRIMS, CollectiveOrderPass
+from .vmem import (VMEM_LIMIT_BYTES, VMEM_RESERVE_BYTES, KernelFootprint,
+                   VmemBudgetPass, fits_vmem, footprint_of_callable,
+                   pallas_footprints)
+from .purity import CALLBACK_PRIMS, EXPENSIVE_PRIMS, PurityPass
+from .programs import ProgramSkip, build_programs, builder_names
+
+#: default trace pass set, in rule-id order.
+TRACE_PASSES = [DtypeLeakPass, DonationPass, CollectiveOrderPass,
+                VmemBudgetPass, PurityPass]
+
+TRACE_RULES = {p.rule: p for p in TRACE_PASSES}
+
+__all__ = ["TraceProgram", "TracePass", "TraceAnalyzer", "EqnSite",
+           "walk_eqns", "op_paths", "subjaxprs",
+           "DtypeLeakPass", "DonationPass", "CollectiveOrderPass",
+           "VmemBudgetPass", "PurityPass",
+           "F32_ACCUM_OPS", "COLLECTIVE_PRIMS", "CALLBACK_PRIMS",
+           "EXPENSIVE_PRIMS", "VMEM_LIMIT_BYTES", "VMEM_RESERVE_BYTES",
+           "KernelFootprint", "pallas_footprints", "footprint_of_callable",
+           "fits_vmem", "ProgramSkip", "build_programs", "builder_names",
+           "TRACE_PASSES", "TRACE_RULES"]

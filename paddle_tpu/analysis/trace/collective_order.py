@@ -1,0 +1,167 @@
+"""TPU503 — collective-order and axis safety inside traced programs.
+
+The deadlock class the AST tier's TPU301 cannot prove: collectives on TPU
+are *rendezvous* ops — every participant of an axis must issue the same
+collective sequence.  A ``lax.cond`` whose branches issue different
+collective sequences deadlocks the fleet the first time the predicate
+diverges across devices (and XLA will not stop you).  Likewise a
+collective over an axis the program's mesh never declared, or a
+``ppermute`` whose permutation indexes outside the axis extent, is a
+guaranteed runtime failure that only shows up once a real multi-chip job
+is already running.
+
+Three mechanical checks over the jaxpr (recursing through pjit /
+shard_map / scan / while bodies):
+
+* **branch parity** — every ``cond`` has the identical ordered collective
+  signature ``(primitive, axes)`` on all branches;
+* **axis membership** — every named axis used by a collective is declared
+  by the program's mesh (registry metadata or the enclosing ``shard_map``
+  equation's mesh param), and any ``shard_map`` mesh agrees with the
+  declared axis sizes;
+* **permutation bounds** — ``ppermute`` pairs stay inside the axis size.
+
+Scoping: collectives inside ``while`` bodies are checked for axis
+membership but not trip-count uniformity (data-dependent trip counts are
+undecidable statically); positional (int) axes are hardware-anonymous and
+skipped.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding
+from .core import OpPathCounter, TracePass, TraceProgram, subjaxprs
+
+__all__ = ["COLLECTIVE_PRIMS", "CollectiveOrderPass"]
+
+#: rendezvous collectives (axis_index is per-device arithmetic, not a
+#: rendezvous — excluded on purpose).
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "pgather",
+})
+
+
+def _named_axes(eqn) -> Tuple[str, ...]:
+    """String axis names a collective equation rendezvouses over."""
+    params = eqn.params
+    raw = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(raw, (tuple, list)):
+        raw = (raw,)
+    return tuple(a for a in raw if isinstance(a, str))
+
+
+def _collective_signature(jaxpr) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
+    """Ordered (primitive, axes) sequence of every collective reachable in
+    a jaxpr, depth-first — the rendezvous schedule a device executes."""
+    sig: List[Tuple[str, Tuple[str, ...]]] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            sig.append((eqn.primitive.name, _named_axes(eqn)))
+        for _tag, sub in subjaxprs(eqn):
+            sig.extend(_collective_signature(sub))
+    return tuple(sig)
+
+
+def _mesh_axes_of(eqn) -> Optional[Dict[str, int]]:
+    mesh = eqn.params.get("mesh")
+    if mesh is None:
+        return None
+    try:
+        return dict(zip(mesh.axis_names, mesh.devices.shape))
+    except Exception:
+        try:
+            return dict(mesh.shape)
+        except Exception:
+            return None
+
+
+class CollectiveOrderPass(TracePass):
+    """TPU503: uniform collective schedules, declared axes, legal perms."""
+
+    rule = "TPU503"
+    name = "collective_order"
+    description = ("identical collective sequence on all cond branches; "
+                   "collective axes declared by the mesh with consistent "
+                   "sizes; ppermute permutations in range")
+
+    def check(self, program: TraceProgram) -> Iterable[Finding]:
+        if program.jaxpr is None:
+            return
+        declared = dict(program.meta.get("mesh_axes", {}) or {})
+        jaxpr = getattr(program.jaxpr, "jaxpr", program.jaxpr)
+        yield from self._walk(program, jaxpr, declared, OpPathCounter())
+
+    def _walk(self, program, jaxpr, declared, counter) -> Iterable[Finding]:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            path = counter.path_for(eqn)
+
+            scope_axes = dict(declared)
+            if prim == "shard_map":
+                sm_axes = _mesh_axes_of(eqn)
+                if sm_axes:
+                    for ax, size in sm_axes.items():
+                        if declared and ax not in declared:
+                            yield self.finding(
+                                program, path,
+                                "shard_map runs over axis %r which the "
+                                "program's declared mesh (%s) does not "
+                                "carry — trace and deployment topology "
+                                "disagree"
+                                % (ax, ", ".join(sorted(declared))))
+                        elif declared and declared[ax] != size:
+                            yield self.finding(
+                                program, path,
+                                "shard_map mesh axis %r has size %d but "
+                                "the program declares %d — the traced "
+                                "program and the declared mesh disagree"
+                                % (ax, size, declared[ax]))
+                    # inside the shard_map body, ITS mesh is the law
+                    scope_axes = dict(sm_axes)
+
+            if prim in COLLECTIVE_PRIMS:
+                axes = _named_axes(eqn)
+                for ax in axes:
+                    if scope_axes and ax not in scope_axes:
+                        yield self.finding(
+                            program, path,
+                            "collective %s over axis %r, which the "
+                            "program's mesh (%s) does not declare — "
+                            "guaranteed unbound-axis failure on a real "
+                            "fleet" % (prim, ax,
+                                       ", ".join(sorted(scope_axes))))
+                if prim == "ppermute":
+                    perm = eqn.params.get("perm") or ()
+                    sizes = [scope_axes[a] for a in axes
+                             if a in scope_axes]
+                    if sizes:
+                        size = sizes[0]
+                        bad = [(s, d) for s, d in perm
+                               if not (0 <= s < size and 0 <= d < size)]
+                        if bad:
+                            yield self.finding(
+                                program, path,
+                                "ppermute pair%s %s outside axis size %d"
+                                % ("s" if len(bad) > 1 else "",
+                                   bad, size))
+
+            if prim == "cond":
+                branches = eqn.params.get("branches") or ()
+                sigs = []
+                for br in branches:
+                    inner = getattr(br, "jaxpr", br)
+                    sigs.append(_collective_signature(inner))
+                if len(set(sigs)) > 1:
+                    desc = "; ".join(
+                        "branch %d: %s" % (i, list(s) if s else "none")
+                        for i, s in enumerate(sigs))
+                    yield self.finding(
+                        program, path,
+                        "cond branches issue different collective "
+                        "sequences (%s) — deadlock if the predicate ever "
+                        "diverges across devices" % desc)
+
+            for _tag, sub in subjaxprs(eqn):
+                yield from self._walk(program, sub, scope_axes, counter)

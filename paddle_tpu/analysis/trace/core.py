@@ -1,0 +1,217 @@
+"""tpu-audit core — the jaxpr/StableHLO trace-tier pass framework.
+
+tpu-lint (the AST tier, :mod:`paddle_tpu.analysis.core`) polices what the
+*source text* shows; this tier polices what the *compiler sees*: passes run
+over the jaxprs and lowered StableHLO of a checked-in registry of canonical
+programs (:mod:`.programs` — the GPT TrainStep fwd+bwd, the 1F1B pipeline
+step, the KV-cache decode artifact, every registered Pallas kernel
+variant).  A missed buffer donation, an f32 upcast inside a bf16 region or
+a VMEM-overflowing block layout are all invisible to the AST but mechanical
+to detect here.
+
+The tier reuses tpu-lint's reporting machinery wholesale: findings are
+:class:`~paddle_tpu.analysis.core.Finding` objects whose ``path`` is the
+**program name** and whose ``symbol`` is a stable **op-path** (name-stack +
+primitive + ordinal), so ``tools/tpu_lint_baseline.txt`` entries key on
+``(rule, program, op-path)`` exactly like the AST tier keys on
+``(rule, file, qualname)`` — one baseline file, one reason-required format,
+one stale-entry report.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, \
+    Sequence, Tuple
+
+from ..core import Finding, Report
+
+__all__ = ["TraceProgram", "TracePass", "TraceAnalyzer", "walk_eqns",
+           "op_paths", "subjaxprs", "EqnSite", "OpPathCounter"]
+
+
+@dataclasses.dataclass
+class TraceProgram:
+    """One canonical program under audit.
+
+    * ``jaxpr`` — the ClosedJaxpr of the traced entry (outermost; passes
+      recurse through pjit/shard_map/cond/scan/while/pallas_call).
+    * ``lowered_text`` — StableHLO of the lowered entry when the program
+      has one (kernels are audited at the jaxpr level only).
+    * ``meta`` — program facts the passes check against:
+        ``donated_invars``   tuple of bools per flat entry input
+        ``donate_labels``    {flat input index: human label} for findings
+        ``mesh_axes``        {axis name: size} declared for the program
+        ``bf16_region``      True when compute is declared bf16 (TPU501)
+        ``allow_callbacks``  True to exempt host callbacks (TPU505)
+        ``kind``             "train_step" | "pipeline" | "decode" |
+                             "pallas_kernel" | "fixture"
+    """
+
+    name: str
+    jaxpr: Any
+    lowered_text: Optional[str] = None
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnSite:
+    """One jaxpr equation with its stable op-path."""
+
+    eqn: Any
+    path: str            # e.g. "transformer/attn/dot_general.1"
+    depth: int
+    parent: Optional[Any]  # the enclosing call-like eqn (pjit/scan/...)
+
+
+def subjaxprs(eqn) -> List[Tuple[str, Any]]:
+    """(param name, Jaxpr) pairs nested under one equation, in param order.
+    Understands ClosedJaxpr wrappers and list/tuple-valued params
+    (``cond``'s branches)."""
+    out: List[Tuple[str, Any]] = []
+    for pname, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for i, v in enumerate(vals):
+            inner = getattr(v, "jaxpr", v)
+            if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+                tag = pname if len(vals) == 1 else "%s[%d]" % (pname, i)
+                out.append((tag, inner))
+    return out
+
+
+def _name_stack(eqn) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+class OpPathCounter:
+    """THE op-path assignment for the trace tier: every pass and
+    :func:`walk_eqns` share this one implementation, because baseline
+    entries and fixture pins key on the exact string — a second copy that
+    drifted would silently stop matching accepted debt.
+
+    Paths are ``<name-stack>/<primitive>.<ordinal>`` where the ordinal
+    counts prior equations with the same (name-stack, primitive) anywhere
+    in the program (in deterministic depth-first eqns-then-subjaxprs
+    order) — stable under unrelated edits, pinnable in fixtures and
+    baselines.  One counter instance per program walk.
+    """
+
+    def __init__(self):
+        self._counts: Dict[Tuple[str, str], int] = {}
+
+    def path_for(self, eqn) -> str:
+        prim = eqn.primitive.name
+        stack = _name_stack(eqn)
+        key = (stack, prim)
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        return "%s/%s.%d" % (stack, prim, n) if stack \
+            else "%s.%d" % (prim, n)
+
+
+def walk_eqns(closed_jaxpr, *, into_pallas: bool = True
+              ) -> Iterator[EqnSite]:
+    """Depth-first walk over every equation of a (Closed)Jaxpr, recursing
+    through call-like primitives, with :class:`OpPathCounter` paths."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    counter = OpPathCounter()
+
+    def rec(jx, depth, parent):
+        for eqn in jx.eqns:
+            path = counter.path_for(eqn)
+            yield EqnSite(eqn=eqn, path=path, depth=depth, parent=parent)
+            if eqn.primitive.name == "pallas_call" and not into_pallas:
+                continue
+            for _tag, sub in subjaxprs(eqn):
+                yield from rec(sub, depth + 1, eqn)
+
+    yield from rec(jaxpr, 0, None)
+
+
+def op_paths(closed_jaxpr) -> List[str]:
+    return [site.path for site in walk_eqns(closed_jaxpr)]
+
+
+class TracePass:
+    """Base class for trace-tier passes: ``check(program)`` yields findings
+    for one :class:`TraceProgram`.  ``prepare(programs)`` runs once with
+    the full registry in scope."""
+
+    rule = "TPU500"
+    name = "trace-base"
+    description = ""
+
+    def prepare(self, programs: Sequence[TraceProgram]) -> None:
+        pass
+
+    def check(self, program: TraceProgram) -> Iterable[Finding]:
+        return []
+
+    # -- shared helper -------------------------------------------------------
+    def finding(self, program: TraceProgram, op_path: str,
+                message: str, line: int = 0) -> Finding:
+        return Finding(rule=self.rule, path=program.name, line=line, col=0,
+                       message=message, symbol=op_path)
+
+
+class TraceAnalyzer:
+    """Run trace passes over a program set and fold in the baseline.
+
+    Mirrors :class:`paddle_tpu.analysis.core.Analyzer`, but the unit of
+    analysis is a program, not a file; only TPU5xx baseline entries apply
+    (the AST tier symmetrically ignores them), so running one tier never
+    reports the other tier's baseline as stale.
+    """
+
+    def __init__(self, root: Optional[str] = None, passes=None,
+                 baseline_path: Optional[str] = "auto"):
+        import os
+        from . import TRACE_PASSES
+        from ..baseline import Baseline
+        self.root = os.path.abspath(root or os.getcwd())
+        self.passes = [p() if isinstance(p, type) else p
+                       for p in (passes if passes is not None
+                                 else TRACE_PASSES)]
+        if baseline_path == "auto":
+            baseline_path = os.path.join(self.root, "tools",
+                                         "tpu_lint_baseline.txt")
+            if not os.path.exists(baseline_path):
+                baseline_path = None
+        base = Baseline.load(baseline_path) if baseline_path else Baseline([])
+        self.baseline = base.subset(lambda e: e.rule.startswith("TPU5"))
+
+    def run(self, programs: Sequence[TraceProgram],
+            errors: Sequence[str] = (), partial: bool = False) -> Report:
+        report = Report([], [], [], [], list(errors))
+        report.files = len(programs)
+        # ``partial=True`` (a pattern-filtered CLI run) scopes the
+        # baseline to the audited programs so entries for un-built ones
+        # are not falsely reported stale.  Full runs keep the whole
+        # baseline: they are the authority on genuinely-dead entries
+        # (e.g. a renamed program), which must keep surfacing so the
+        # file shrinks over time.
+        baseline = self.baseline
+        if partial:
+            names = {p.name for p in programs}
+            baseline = baseline.subset(lambda e: e.path in names)
+        for pz in self.passes:
+            pz.prepare(programs)
+        raw: List[Finding] = []
+        for pz in self.passes:
+            for prog in programs:
+                try:
+                    raw.extend(pz.check(prog))
+                except Exception as e:  # a crashed pass must fail loudly,
+                    report.errors.append(   # not silently skip its rule
+                        "%s on %s: %s: %s" % (pz.rule, prog.name,
+                                              type(e).__name__, e))
+        raw.sort(key=lambda f: (f.path, f.symbol, f.rule))
+        for f in raw:
+            if baseline.matches(f):
+                report.baselined.append(f)
+            else:
+                report.findings.append(f)
+        report.stale_baseline = baseline.stale()
+        return report

@@ -1,0 +1,259 @@
+"""The canonical-program registry for the trace-tier audit.
+
+The audit is only as strong as the set of programs it sees, so the
+registry pins the repo's compiled entry points the way
+``tests/analysis_fixtures/`` pins AST shapes:
+
+* ``gpt_train_step`` — TrainStep fwd+bwd+update on ``GPTConfig.tiny``
+  (the program the x64 HLO audit already compiles; donation declared on
+  params/buffers/opt_state);
+* ``pipeline_1f1b`` — the shard_map'd 1F1B step with an SGD update over a
+  ('pp',) mesh (``paddle_tpu.distributed.pipeline.canonical_1f1b_step``);
+* ``gpt_decode`` — the KV-cache one-token decode step of the inference
+  artifact (prefill eagerly, trace the cached decode);
+* ``pallas/<family>/<variant>`` — every registered Pallas kernel variant,
+  traced at the bench-standard key in bf16 (``bf16_region`` metadata set,
+  so TPU501 audits the variants' f32 usage against F32_ACCUM_OPS).
+
+Builders are lazy and isolated: a builder that cannot run in this
+environment (e.g. too few devices for the pipeline mesh) raises
+:class:`ProgramSkip` and is reported as a skip, not a failure — but an
+unexpectedly *broken* builder is an operational error that fails the CLI,
+because a silently-empty registry would turn the strict gate green while
+auditing nothing.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .core import TraceProgram
+
+__all__ = ["ProgramSkip", "register_builder", "build_programs",
+           "builder_names"]
+
+
+class ProgramSkip(RuntimeError):
+    """Raised by a builder whose preconditions this environment lacks."""
+
+
+def _ensure_virtual_devices(n: int = 8):
+    """Best-effort XLA_FLAGS default for embedders who call
+    :func:`build_programs` before anything initialized the jax backend.
+    It CANNOT help the CLI or tests: ``import paddle_tpu`` already
+    initializes the backend, so by the time this runs the flag is a
+    no-op there — the CLI must be launched with shell-level
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (CI does;
+    tests get it from conftest.py).  Builders that then find too few
+    devices skip, and the CLI reports the skip as a loud warning with
+    the fix."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n
+        ).strip()
+
+
+#: name -> (builder, name-prefix of every program it emits).  A single
+#: logical entry point may expand to many programs (the kernel variants);
+#: the prefix lets pattern-filtered runs skip builders that cannot match
+#: BEFORE paying their trace/lower cost.
+_BUILDERS: Dict[str, Tuple[Callable[[], List[TraceProgram]], str]] = {}
+
+
+def register_builder(name: str, prefix: Optional[str] = None):
+    def deco(fn):
+        _BUILDERS[name] = (fn, prefix if prefix is not None else name)
+        return fn
+    return deco
+
+
+def _pattern_may_match(prefix: str, pattern: str) -> bool:
+    """Conservative pre-filter: can ``pattern`` possibly match a name
+    starting with ``prefix``?  Compares the pattern's literal head (up to
+    its first wildcard) against the prefix — over-approximates (never
+    skips a builder whose programs could match)."""
+    import re
+    literal = re.split(r"[*?\[]", pattern, 1)[0]
+    return literal.startswith(prefix) or prefix.startswith(literal)
+
+
+def builder_names() -> List[str]:
+    return sorted(_BUILDERS)
+
+
+def _donate_labels(args) -> Dict[int, str]:
+    """{flat input index: tree-path label} for a jitted entry's argument
+    tuple — makes TPU502 findings name the parameter, not an index."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tuple(args))
+    return {i: "args" + jax.tree_util.keystr(kp)
+            for i, (kp, _v) in enumerate(flat)}
+
+
+@register_builder("gpt_train_step")
+def _build_gpt_train_step() -> List[TraceProgram]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(model, lambda lo, la: crit(lo, la), opt)
+    x = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 32)).astype(np.int32))
+    args = step.trace_args((x, x))
+    # keep_unused=True for the AUDIT wrap only: the production step prunes
+    # unused inputs (e.g. the rng key when every dropout prob is 0), which
+    # would misalign the lowered entry's argument indices against the
+    # jaxpr's donation flags
+    audit_step = jax.jit(step._step_fn,
+                         donate_argnums=step._donate_argnums,
+                         keep_unused=True)
+    jaxpr = jax.make_jaxpr(audit_step)(*args)
+    lowered = audit_step.lower(*args)
+    return [TraceProgram(
+        name="gpt_train_step", jaxpr=jaxpr,
+        lowered_text=lowered.as_text(),
+        meta={"kind": "train_step", "mesh_axes": {},
+              "donate_labels": _donate_labels(args)})]
+
+
+@register_builder("pipeline_1f1b")
+def _build_pipeline_1f1b() -> List[TraceProgram]:
+    import jax
+
+    from paddle_tpu.distributed.pipeline import (
+        PipelinePreconditionError, canonical_1f1b_step)
+
+    try:
+        jitted, args, meta = canonical_1f1b_step()
+    except PipelinePreconditionError as e:
+        # ONLY the environment precondition is a skip; any other failure
+        # propagates into the errors list and fails the strict CLI
+        raise ProgramSkip(str(e))
+    jaxpr = jax.make_jaxpr(jitted)(*args)
+    lowered = jitted.lower(*args)
+    meta = dict(meta)
+    meta["donate_labels"] = _donate_labels(args)
+    return [TraceProgram(name="pipeline_1f1b", jaxpr=jaxpr,
+                         lowered_text=lowered.as_text(), meta=meta)]
+
+
+@register_builder("gpt_decode")
+def _build_gpt_decode() -> List[TraceProgram]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import functional_call
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = Tensor(jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, 8)).astype(np.int32)))
+    # eager prefill fills the KV cache; the traced program is the
+    # per-token cached decode the serving loop runs
+    _logits, cache = model(prompt, cache=model.gen_cache(1))
+    cache_arrays = [(k._array, v._array) for k, v in cache]
+    state = model.functional_state()
+
+    def decode_step(state, x, cache):
+        cache_t = [(Tensor(k), Tensor(v)) for k, v in cache]
+        (logits, new_cache), _ = functional_call(
+            model, state, Tensor(x), cache=cache_t)
+        return logits, new_cache
+
+    x1 = jnp.asarray(np.full((1, 1), 7, np.int32))
+    jitted = jax.jit(decode_step)
+    jaxpr = jax.make_jaxpr(jitted)(state, x1, cache_arrays)
+    lowered = jitted.lower(state, x1, cache_arrays)
+    return [TraceProgram(
+        name="gpt_decode", jaxpr=jaxpr, lowered_text=lowered.as_text(),
+        meta={"kind": "decode", "mesh_axes": {}})]
+
+
+@register_builder("pallas_kernels", prefix="pallas/")
+def _build_pallas_kernels() -> List[TraceProgram]:
+    import jax
+
+    from paddle_tpu.kernels import autotune as at
+
+    at._import_kernel_families()
+    out: List[TraceProgram] = []
+    for fam_name, key in at.standard_keys():
+        fam = at.families().get(fam_name)
+        if fam is None or fam.traceable is None:
+            continue
+        # audit at bf16 regardless of host platform: the TPU production
+        # dtype is what TPU501's bf16-region rule is about, and tracing
+        # executes nothing, so the host backend doesn't matter
+        key = dict(key, dtype="bfloat16")
+        seen = set()
+        for cand in fam.candidates(key):
+            variant = cand.get("variant", "base")
+            if variant in seen:
+                continue   # one program per VARIANT; block-size siblings
+            seen.add(variant)        # lower the same kernel structure
+            fn, args = fam.traceable(cand, key)
+            jaxpr = jax.make_jaxpr(fn)(*args)
+            out.append(TraceProgram(
+                name="pallas/%s/%s" % (fam_name, variant), jaxpr=jaxpr,
+                meta={"kind": "pallas_kernel", "bf16_region": True,
+                      "mesh_axes": {}, "family": fam_name,
+                      "variant": variant, "autotune_key": at.key_str(key)}))
+    if not out:
+        raise ProgramSkip("no kernel families expose traceables")
+    return out
+
+
+def build_programs(patterns: Optional[Sequence[str]] = None
+                   ) -> Tuple[List[TraceProgram], List[str], List[str]]:
+    """Build the registry (optionally fnmatch-filtered by program name).
+
+    Returns ``(programs, skipped, errors)`` — ``skipped`` are builders
+    whose environment preconditions failed (reported, non-fatal);
+    ``errors`` are broken builders (fatal under the CLI: an empty audit
+    must not look green).
+    """
+    _ensure_virtual_devices()
+    programs: List[TraceProgram] = []
+    skipped: List[str] = []
+    errors: List[str] = []
+    for name in builder_names():
+        builder, prefix = _BUILDERS[name]
+        if patterns and not any(_pattern_may_match(prefix, pat)
+                                for pat in patterns):
+            continue  # no pattern can match this builder's programs —
+            # skip its trace/lower cost entirely ('pallas/*' runs must
+            # not pay for the GPT train-step lowering)
+        try:
+            built = builder()
+        except ProgramSkip as e:
+            skipped.append("%s: %s" % (name, e))
+            continue
+        except Exception as e:
+            errors.append("builder %s failed: %s: %s"
+                          % (name, type(e).__name__, e))
+            continue
+        programs.extend(built)
+    if patterns:
+        programs = [p for p in programs
+                    if any(fnmatch.fnmatch(p.name, pat)
+                           for pat in patterns)]
+    return programs, skipped, errors

@@ -213,3 +213,23 @@ class Hessian:
             n *= s
         arr = h.reshape(n, n)
         return Tensor(arr[idx] if idx is not None else arr)
+
+
+# -- prim-mode shims (folded in from the deprecated incubate.autograd) ------
+# The reference lowers ops to autodiff primitives ("prim mode") to do what
+# jax.vjp/jvp do natively; on TPU every trace already IS the primitive
+# graph, so these are honest no-ops kept for API parity.
+
+def enable_prim():
+    """No-op: jax traces ARE the primitive graph."""
+
+
+def disable_prim():
+    """No-op (see enable_prim)."""
+
+
+def prim_enabled() -> bool:
+    return True
+
+
+__all__ += ["enable_prim", "disable_prim", "prim_enabled"]

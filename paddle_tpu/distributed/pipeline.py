@@ -841,7 +841,10 @@ class _CompiledPipelineStep:
                 params, grads, opt_state, lr)
             return loss, jnp.bool_(True), new_params, new_opt
 
-        self._step = jax.jit(full_step, donate_argnums=(0, 1))
+        # recorded for the trace-tier donation audit (TPU502): params and
+        # opt_state are the two donated trees; a miss doubles peak HBM
+        self._donate_argnums = (0, 1)
+        self._step = jax.jit(full_step, donate_argnums=self._donate_argnums)
 
     def step(self, x, y, scale=None):
         x_a = x._array if isinstance(x, Tensor) else jnp.asarray(x)
@@ -1039,3 +1042,77 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return total / acc
+
+
+class PipelinePreconditionError(RuntimeError):
+    """This ENVIRONMENT cannot build the canonical pipeline program (e.g.
+    too few devices for the mesh) — distinct from a genuinely broken
+    builder, so the trace-tier registry can record a skip for the former
+    and a hard operational error for the latter."""
+
+
+def canonical_1f1b_step(num_stages: int = 4, num_micro: int = 4,
+                        d: int = 16, mb: int = 2, lr: float = 0.05):
+    """Registry hook for the trace-tier audit (paddle_tpu.analysis.trace):
+    a self-contained jitted 1F1B train-like step over a ('pp',) mesh —
+    shard_map'd :func:`spmd_pipeline_1f1b` plus an SGD update with the
+    params donated, i.e. the same donation/collective structure
+    :class:`_CompiledPipelineStep` builds, at audit-sized shapes.
+
+    Returns ``(jitted_fn, args, meta)`` where ``meta`` carries the
+    declared mesh axes and per-flat-input donation labels the TPU502/503
+    passes check against.  Raises :class:`PipelinePreconditionError` when
+    fewer than ``num_stages`` devices are available (the registry records
+    that as a skip; any OTHER exception is a broken builder and fails the
+    audit)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # jax<=0.4.x: only the experimental spelling
+        from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    if len(devices) < num_stages:
+        raise PipelinePreconditionError(
+            "canonical_1f1b_step needs %d devices, have %d (force a CPU "
+            "mesh with --xla_force_host_platform_device_count)"
+            % (num_stages, len(devices)))
+    mesh = Mesh(np.asarray(devices[:num_stages]), ("pp",))
+
+    def stage_fn(params, x):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + x
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(num_stages, d, d) * 0.3, jnp.float32),
+        "b1": jnp.asarray(rng.randn(num_stages, d) * 0.1, jnp.float32),
+        "w2": jnp.asarray(rng.randn(num_stages, d, d) * 0.3, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(num_micro, mb, d), jnp.float32)
+    labels = jnp.asarray(rng.randn(num_micro, mb, d), jnp.float32)
+
+    pspec = jax.tree_util.tree_map(lambda _: P("pp"), params)
+    pipe = shard_map(
+        lambda p, x_, l_: spmd_pipeline_1f1b(
+            stage_fn, loss_fn, p, x_, l_, num_stages, num_micro),
+        mesh=mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec), check_rep=False)
+
+    def full_step(params, x, labels):
+        loss, grads = pipe(params, x, labels)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return loss, new_params
+
+    jitted = jax.jit(full_step, donate_argnums=(0,))
+    flat, _ = jax.tree_util.tree_flatten_with_path((params, x, labels))
+    labels_by_idx = {i: "args" + jax.tree_util.keystr(kp)
+                     for i, (kp, _v) in enumerate(flat)}
+    meta = {"mesh_axes": {"pp": num_stages},
+            "donate_labels": labels_by_idx,
+            "kind": "pipeline"}
+    return jitted, (params, x, labels), meta

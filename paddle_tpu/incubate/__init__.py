@@ -1,5 +1,4 @@
 """paddle_tpu.incubate (reference surface: python/paddle/incubate/)."""
-from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
@@ -12,3 +11,14 @@ from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                         segment_max, segment_mean, segment_min,
                         segment_sum, softmax_mask_fuse,
                         softmax_mask_fuse_upper_triangle)
+
+
+def __getattr__(name):
+    # `incubate.autograd` is deprecated (folded into paddle_tpu.autograd)
+    # — imported lazily so its DeprecationWarning fires at USE, not on
+    # every `import paddle_tpu`
+    if name == "autograd":
+        from . import autograd
+        return autograd
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,26 +1,27 @@
-"""paddle.incubate.autograd (reference: python/paddle/incubate/autograd/ —
-functional vjp/jvp/Jacobian/Hessian primitives).
+"""DEPRECATED — ``paddle_tpu.incubate.autograd`` folded into
+``paddle_tpu.autograd``.
 
-The stable ``paddle.autograd`` package already carries the functional
-transforms (they are jax-native here); this module is the incubate-path
-alias the reference exposes, plus prim-mode shims (`enable_prim` — on TPU
-every trace is already "primitive mode": jax primitives + XLA)."""
+The incubate path carried nothing of its own: the functional transforms
+(vjp/jvp/Jacobian/Hessian) were already re-exports of the stable package,
+and the prim-mode shims (enable_prim/disable_prim/prim_enabled) now live
+there too.  Importing this module works but warns; switch to::
+
+    from paddle_tpu.autograd import vjp, jvp, Jacobian, Hessian
+    from paddle_tpu.autograd import enable_prim, prim_enabled
+"""
 from __future__ import annotations
 
-from ..autograd import Hessian, Jacobian, jvp, vjp  # noqa: F401
+import warnings
+
+warnings.warn(
+    "paddle_tpu.incubate.autograd is deprecated and has been folded into "
+    "paddle_tpu.autograd — import vjp/jvp/Jacobian/Hessian and the "
+    "enable_prim/disable_prim/prim_enabled shims from there instead. "
+    "This alias module will be removed.",
+    DeprecationWarning, stacklevel=2)
+
+from ..autograd import (Hessian, Jacobian, disable_prim,  # noqa: E402,F401
+                        enable_prim, jvp, prim_enabled, vjp)
 
 __all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
            "disable_prim", "prim_enabled"]
-
-
-def enable_prim():
-    """No-op: jax traces ARE the primitive graph (the reference lowers ops
-    to autodiff primitives to do what jax.vjp/jvp do natively)."""
-
-
-def disable_prim():
-    """No-op (see enable_prim)."""
-
-
-def prim_enabled() -> bool:
-    return True

@@ -591,7 +591,28 @@ class TrainStep:
             return loss, new_params, new_buffers, new_opt_state
 
         donate_args = (0, 1, 2) if donate else ()
+        # recorded for the trace-tier donation audit (TPU502 in
+        # paddle_tpu.analysis.trace): the registry lowers self._step with
+        # trace_args() and verifies each declared donation materializes
+        # as input-output aliasing in the compiled entry
+        self._donate_argnums = donate_args
+        self._step_fn = step_fn   # un-jitted, for audit re-wraps
         self._step = jax.jit(step_fn, donate_argnums=donate_args)
+
+    def trace_args(self, batch):
+        """The exact argument tuple ``self._step`` runs with, for
+        lowering/audit (``self._step.lower(*step.trace_args(batch))``).
+        ``batch`` is the tuple a normal ``step(*batch)`` call would take.
+
+        Uses a FIXED key rather than drawing from the global stream: the
+        result is only lowered, never executed, and auditing a live step
+        must not shift every subsequent dropout mask of the real run.
+        ``jax.random.key`` (typed) matches the aval the production
+        ``__call__`` passes, so the audit lowers the identical program."""
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        rng = jax.random.key(0)
+        return (self.params, self.buffers, self.opt_state, lr, rng,
+                _unwrap_tree(tuple(batch)))
 
     def __call__(self, *batch):
         rng = _rnd.next_key()

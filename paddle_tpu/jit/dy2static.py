@@ -45,6 +45,53 @@ data-INdependent control flow needs no rewrite under jax tracing anyway):
   the loop (the lax carry needs a typed initial value — the reference's
   RETURN_NO_VALUE magic-number trick, rendered statically); bare and
   valued returns cannot mix in one loop.
+
+Transform matrix — reference transformer vs this build (statuses:
+SUPPORTED = rewritten to lax control flow; TRACE = not rewritten because
+jax tracing already handles it (data-independent, unrolled at trace);
+UNSUPPORTED = Dy2StaticUnsupportedError at transform time, to_static
+falls back to trace-only compilation and keeps the reason on
+``_dy2static_error``):
+
+=============================  ===========  ==============================
+reference transformer          status       notes / unsupported shapes
+=============================  ===========  ==============================
+ifelse_transformer             SUPPORTED    assign-only branches, or both
+                                            branches ending in ``return``;
+                                            mixed shapes, effect-only
+                                            branches, break/continue in a
+                                            branch: UNSUPPORTED
+loop_transformer (while)       SUPPORTED    carried vars must be bound
+                                            before the loop;
+                                            ``while/else``: UNSUPPORTED
+loop_transformer (for-range)   SUPPORTED    lax.fori_loop when a bound is
+                                            traced; step must be concrete
+loop_transformer (for-tensor)  SUPPORTED    lax.scan over the leading axis
+loop_transformer (for-iter)    TRACE        python iterables unroll at
+                                            trace; traced-index indexing
+                                            of a python sequence and
+                                            tensor-predicated ``break``:
+                                            UNSUPPORTED
+break_continue_transformer     SUPPORTED    desugared to carried guard
+                                            flags; inside a converted
+                                            ``if`` branch: UNSUPPORTED
+return_transformer             SUPPORTED    one carried return slot at
+                                            body top level; bare+valued
+                                            mixed returns: UNSUPPORTED
+logical_transformer            TRACE        and/or/not on traced bools are
+                                            jnp ops already
+cast/call/print/assert/        TRACE        no ProgramDesc to protect:
+tensor_shape/typehint                       python-level casts, prints and
+transformers                                shape reads trace through jax
+                                            natively (shape is static)
+list/dict transformers         UNSUPPORTED  LoDTensorArray has no TPU
+                                            analogue: tensor lists inside
+                                            converted control flow must be
+                                            stacked arrays (lax carries
+                                            are fixed pytrees)
+decorator/early_return/        TRACE        handled by python semantics
+grad (name_load)                            under tracing
+=============================  ===========  ==============================
 """
 from __future__ import annotations
 

@@ -77,7 +77,8 @@ class KernelFamily:
     def __init__(self, name: str,
                  candidates: Callable[[dict], List[dict]],
                  runner: Optional[Callable[[dict, dict], Callable]] = None,
-                 cleanup: Optional[Callable[[dict], None]] = None):
+                 cleanup: Optional[Callable[[dict], None]] = None,
+                 traceable: Optional[Callable] = None):
         self.name = name
         self.candidates = candidates
         self.runner = runner
@@ -85,11 +86,19 @@ class KernelFamily:
         # device operands the runners cached for that key (they would
         # otherwise pin HBM for the life of the training process)
         self.cleanup = cleanup
+        # ``traceable(candidate, key) -> (fn, abstract_args)`` builds the
+        # candidate's program for ABSTRACT tracing only (args are
+        # ShapeDtypeStructs; nothing executes).  Feeds the TPU504 static
+        # VMEM estimator: tune() prices every candidate's BlockSpec
+        # working set BEFORE compiling and rejects the unfittable ones,
+        # and the trace-tier audit registers one canonical program per
+        # variant from the same hook.
+        self.traceable = traceable
 
 
 def register_family(name: str, candidates, runner=None,
-                    cleanup=None) -> KernelFamily:
-    fam = KernelFamily(name, candidates, runner, cleanup)
+                    cleanup=None, traceable=None) -> KernelFamily:
+    fam = KernelFamily(name, candidates, runner, cleanup, traceable)
     with _LOCK:
         _FAMILIES[name] = fam
     return fam
@@ -288,6 +297,24 @@ def _record_event(name: str):
         return contextlib.nullcontext()
 
 
+def _vmem_reject(fam: "KernelFamily", cand: dict, key: dict
+                 ) -> Optional[str]:
+    """Non-empty rejection reason when the candidate's static VMEM
+    footprint (TPU504 estimator, paddle_tpu.analysis.trace.vmem) exceeds
+    the per-core budget.  Estimator problems never block tuning — a
+    candidate we cannot price is timed normally (and fails on-device the
+    way it always did)."""
+    if fam.traceable is None:
+        return None
+    try:
+        from ..analysis.trace.vmem import fits_vmem
+        fn, args = fam.traceable(cand, key)
+        ok, why = fits_vmem(fn, *args)
+    except Exception:
+        return None
+    return None if ok else "rejected: vmem (%s)" % why
+
+
 def tune(family_name: str, key: dict, persist: bool = True,
          verbose: bool = False, run_cleanup: bool = True) -> dict:
     """Time every candidate for ``key`` and select the fastest.
@@ -314,6 +341,16 @@ def tune(family_name: str, key: dict, persist: bool = True,
         with _record_event("autotune::%s::%s" % (family_name, ks)):
             for cand in cands:
                 sig = _cand_sig(cand)
+                rejected = _vmem_reject(fam, cand, key)
+                if rejected:
+                    # TPU504 pre-compile gate: the static BlockSpec
+                    # working set cannot fit per-core VMEM — recorded in
+                    # the timing table instead of faulting on-device
+                    # mid-warm (and wasting a TPU session on it)
+                    timings[sig] = rejected
+                    if verbose:
+                        print("  %-48s %s" % (sig, rejected))
+                    continue
                 try:
                     fn = fam.runner(cand, key)
                     ms = _time_callable(fn, samples)
@@ -332,7 +369,21 @@ def tune(family_name: str, key: dict, persist: bool = True,
             except Exception:
                 pass
     if best is None:
-        best = cands[0]  # everything failed: hand-tuned default
+        # nothing timed successfully.  A statically VMEM-rejected
+        # candidate must NEVER be the fallback — the gate just proved it
+        # faults on device; fall back to the first candidate that at
+        # least fits (runtime failures may be transient/key-specific),
+        # and fail loudly when no candidate fits at all.
+        vmem_rejected = {sig for sig, v in timings.items()
+                         if isinstance(v, str)
+                         and v.startswith("rejected: vmem")}
+        best = next((c for c in cands
+                     if _cand_sig(c) not in vmem_rejected), None)
+        if best is None:
+            raise ValueError(
+                "autotune %s [%s]: no candidate fits per-core VMEM — %s"
+                % (family_name, ks, "; ".join(
+                    "%s -> %s" % kv for kv in sorted(timings.items()))))
         best_ms = float("nan")
     entry = {"variant": best["variant"], "config": dict(best["config"]),
              "ms": None if best_ms != best_ms else round(best_ms, 4),

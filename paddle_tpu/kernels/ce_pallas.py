@@ -297,10 +297,23 @@ def _lse_runner_cleanup(key):
     _LSE_RUNNER_DATA.pop(at.key_str(key), None)
 
 
+def _lse_traceable(cand, key):
+    """Data-free candidate program for the TPU504 VMEM estimator and the
+    trace-tier audit (see flash_attention_pallas._fwd_traceable)."""
+    n, v = key["n"], key["v"]
+    cfg = cand["config"]
+
+    def fn(x):
+        with x64_scope(False):
+            return _lse_call_cfg(x, cfg["block_rows"], cfg["chunk"], True)
+    return fn, (jax.ShapeDtypeStruct((n, v), jnp.dtype(key["dtype"])),)
+
+
 def _lse_register():
     from . import autotune as at
     at.register_family("ce_lse", _lse_candidates, _lse_runner,
-                       cleanup=_lse_runner_cleanup)
+                       cleanup=_lse_runner_cleanup,
+                       traceable=_lse_traceable)
 
 
 def _lse_call(x2, interpret):

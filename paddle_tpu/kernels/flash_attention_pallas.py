@@ -1405,16 +1405,66 @@ def _runner_cleanup(key):
     _RUNNER_DATA.pop(at.key_str(key), None)
 
 
+# -- abstract traceables (TPU504 / trace-tier audit) -------------------------
+# Data-free builders of each candidate's program: args are
+# ShapeDtypeStructs, so make_jaxpr prices the BlockSpec working set
+# without touching a device — the autotuner's pre-compile VMEM gate and
+# the analysis registry's per-variant kernel programs both come from
+# these.
+
+def _fwd_traceable(cand, key):
+    b, s, sk, h, d = (key[k] for k in ("b", "s", "sk", "h", "d"))
+    causal, dtype = key["causal"], jnp.dtype(key["dtype"])
+    cfg = cand["config"]
+    spec = (cand["variant"], cfg["block_q"], cfg["block_k"], cfg["hg"])
+    scale = 1.0 / d ** 0.5
+
+    def fn(q, k, v):
+        return _flash_fwd(q, k, v, causal, scale, d, True, spec)
+    sds = jax.ShapeDtypeStruct
+    return fn, (sds((b, s, h * d), dtype), sds((b, sk, h * d), dtype),
+                sds((b, sk, h * d), dtype))
+
+
+def _bwd_traceable(which):
+    def make(cand, key):
+        b, s, sk, h, d = (key[k] for k in ("b", "s", "sk", "h", "d"))
+        causal, dtype = key["causal"], jnp.dtype(key["dtype"])
+        cfg = cand["config"]
+        bq0, _bk0, _hg_f, hg_b = _default_cfg(key)
+        hg = cfg.get("hg", hg_b)
+        spec = (cand["variant"], cfg["block_q"], cfg["block_k"])
+        scale = 1.0 / d ** 0.5
+        call = {"merged": _bwd_merged_call, "dq": _bwd_dq_call,
+                "dkv": _bwd_dkv_call}[which]
+
+        def fn(q, k, v, do, lse, delta):
+            with x64_scope(False):
+                return call(q, k, v, do, lse, delta, causal, scale, hg, d,
+                            spec, True)
+        sds = jax.ShapeDtypeStruct
+        # lse/delta in the layout the default forward produces (what the
+        # production bwd — and the timed runner — actually receives)
+        return fn, (sds((b, s, h * d), dtype), sds((b, sk, h * d), dtype),
+                    sds((b, sk, h * d), dtype), sds((b, s, h * d), dtype),
+                    sds((b, h // hg_b, hg_b, s // bq0, bq0), jnp.float32),
+                    sds((b, s, h), jnp.float32))
+    return make
+
+
 def _register_families():
     from . import autotune as at
     at.register_family("flash_fwd", _fwd_candidates, _fwd_runner,
-                       cleanup=_runner_cleanup)
+                       cleanup=_runner_cleanup, traceable=_fwd_traceable)
     at.register_family("flash_bwd", _bwd_candidates_merged,
-                       _bwd_runner("merged"), cleanup=_runner_cleanup)
+                       _bwd_runner("merged"), cleanup=_runner_cleanup,
+                       traceable=_bwd_traceable("merged"))
     at.register_family("flash_bwd_dq", _bwd_candidates_split,
-                       _bwd_runner("dq"), cleanup=_runner_cleanup)
+                       _bwd_runner("dq"), cleanup=_runner_cleanup,
+                       traceable=_bwd_traceable("dq"))
     at.register_family("flash_bwd_dkv", _bwd_candidates_split,
-                       _bwd_runner("dkv"), cleanup=_runner_cleanup)
+                       _bwd_runner("dkv"), cleanup=_runner_cleanup,
+                       traceable=_bwd_traceable("dkv"))
 
 
 _register_families()

@@ -290,10 +290,24 @@ def softmax_pallas(x, block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
     return out.reshape(x.shape)
 
 
+def _ln_traceable(cand, key):
+    """Data-free candidate program for the TPU504 VMEM estimator and the
+    trace-tier audit (see flash_attention_pallas._fwd_traceable)."""
+    n, f = key["n"], key["f"]
+    dtype = jnp.dtype(key["dtype"])
+    br = cand["config"]["block_rows"]
+
+    def fn(x, g, b):
+        with x64_scope(False):
+            return _ln_fwd(x, g, b, 1e-5, br, True)
+    sds = jax.ShapeDtypeStruct
+    return fn, (sds((n, f), dtype), sds((f,), dtype), sds((f,), dtype))
+
+
 def _ln_register():
     from . import autotune as at
     at.register_family("ln", _ln_candidates, _ln_runner,
-                       cleanup=_ln_runner_cleanup)
+                       cleanup=_ln_runner_cleanup, traceable=_ln_traceable)
 
 
 _ln_register()

@@ -1,6 +1,7 @@
 """paddle_tpu.nn (reference surface: python/paddle/nn/)."""
 from . import functional
 from . import initializer
+from . import utils
 from .layer.layers import (Layer, LayerList, ParameterList, Sequential)
 from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
                            CosineSimilarity, Dropout, Dropout2D, Dropout3D,

@@ -1,0 +1,157 @@
+"""paddle_tpu.nn.utils (reference surface: python/paddle/nn/utils/) —
+parameter-surgery helpers: gradient clipping, flat-vector round-trips and
+the weight/spectral reparameterizations.
+
+Reparameterization on TPU: the reference mutates the layer's op graph
+(``WeightNormParamAttr`` / a spectral-norm op before every matmul); here
+the same effect is a ``forward_pre_hook`` that recomputes the effective
+``weight`` from the decomposed parameters on every call — inside a jit
+trace that is just more fused elementwise work, no graph surgery.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .clip import clip_grad_norm_  # noqa: F401  (reference home is here)
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise clamp of parameters' ``.grad`` to
+    [-clip_value, clip_value] (reference: nn/utils/clip_grad_value_)."""
+    clip_value = float(clip_value)
+    if clip_value < 0:
+        raise ValueError("clip_value must be non-negative, got %r"
+                         % clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._array = jnp.clip(p.grad._array, -clip_value,
+                                     clip_value)
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten parameters into one 1-D tensor (reference:
+    nn/utils/transform_parameters.py)."""
+    params = list(parameters)
+    if not params:
+        raise ValueError("parameters_to_vector got an empty list")
+    return Tensor(jnp.concatenate(
+        [p._array.reshape(-1) for p in params]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Inverse of :func:`parameters_to_vector`: slice ``vec`` back into
+    the parameters, in place."""
+    arr = vec._array if isinstance(vec, Tensor) else jnp.asarray(vec)
+    params = list(parameters)
+    total = sum(int(p._array.size) for p in params)
+    if int(arr.size) != total:
+        raise ValueError(
+            "vector has %d elements but the parameters hold %d"
+            % (int(arr.size), total))
+    off = 0
+    for p in params:
+        n = int(p._array.size)
+        p._array = arr[off:off + n].reshape(p._array.shape) \
+            .astype(p._array.dtype)
+        off += n
+
+
+def _norm_except_dim(w, dim):
+    """L2 norm over all axes except ``dim`` (paddle/torch weight_norm
+    convention); dim=None -> norm over everything (scalar g)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(a for a in range(w.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as direction*magnitude
+    (w = g * v / ||v||, reference nn/utils/weight_norm_hook.py).
+
+    ``<name>_g`` / ``<name>_v`` become the trainable parameters; the
+    effective weight is recomputed by a forward_pre_hook on every call
+    (so optimizer steps on g/v are reflected immediately, eager or
+    traced).  ``dim=None`` uses one scalar magnitude."""
+    if hasattr(layer, name + "_v"):
+        raise ValueError("weight_norm already applied to %r" % name)
+    w = getattr(layer, name)
+    w_arr = w._array
+    g = Parameter(_norm_except_dim(w_arr, dim))
+    v = Parameter(w_arr)
+    # the original entry must stop being a trainable Parameter: drop it
+    # from _parameters and rebind as a plain attribute-computed buffer
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    object.__setattr__(layer, "_weight_norm_cfg_" + name, (dim,))
+
+    def _recompute(lyr, _inputs):
+        gg = getattr(lyr, name + "_g")._array
+        vv = getattr(lyr, name + "_v")._array
+        norm = _norm_except_dim(vv, dim)
+        eff = vv * (gg / jnp.maximum(norm, 1e-12))
+        object.__setattr__(lyr, name, Tensor(eff))
+        return None
+
+    h = layer.register_forward_pre_hook(_recompute)
+    object.__setattr__(layer, "_weight_norm_hook_" + name, h)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Undo :func:`weight_norm`: bake the current effective weight back
+    into a single Parameter and drop the hook + g/v."""
+    helper = getattr(layer, "_weight_norm_hook_" + name, None)
+    if helper is None:
+        raise ValueError("weight_norm was not applied to %r" % name)
+    helper.remove()
+    eff = getattr(layer, name)
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+        if name + suffix in layer.__dict__:
+            del layer.__dict__[name + suffix]
+    for attr in ("_weight_norm_hook_" + name, "_weight_norm_cfg_" + name,
+                 name):
+        # the hook's effective-weight Tensor lives in __dict__ and would
+        # shadow the restored Parameter on attribute lookup
+        if attr in layer.__dict__:
+            del layer.__dict__[attr]
+    layer.add_parameter(name, Parameter(eff._array))
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Normalize ``layer.<name>`` by its largest singular value, estimated
+    with power iteration on every forward (reference:
+    nn/utils/spectral_norm_hook.py; the layer twin is nn.SpectralNorm).
+
+    Stateless TPU variant: the u/v power-iteration vectors are recomputed
+    from a fixed start each call instead of carried as mutable buffers —
+    trace-pure, so the hook works identically under jit."""
+    from . import functional as F
+
+    w = getattr(layer, name)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(w._array))
+
+    def _recompute(lyr, _inputs):
+        worig = getattr(lyr, name + "_orig")
+        eff = F.spectral_norm(worig, n_power_iterations, eps, dim)
+        arr = eff._array if isinstance(eff, Tensor) else eff
+        object.__setattr__(lyr, name, Tensor(arr))
+        return None
+
+    h = layer.register_forward_pre_hook(_recompute)
+    object.__setattr__(layer, "_spectral_norm_hook_" + name, h)
+    _recompute(layer, None)
+    return layer

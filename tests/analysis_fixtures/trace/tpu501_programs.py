@@ -1,0 +1,38 @@
+"""TPU501 fixtures: bf16-region f32-upcast leaks (positive) and legal
+f32 statistics usage (negative), with pinned op paths."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.trace import TraceProgram
+
+
+def build_programs():
+    def leaky(x):
+        # LEAK 1: transcendental activation on an upcast — the whole
+        # activation tensor re-runs on the f32 VPU path
+        a = jnp.tanh(x.astype(jnp.float32))
+        # LEAK 2: matmul fed f32-converted bf16 operands (should be bf16
+        # operands with preferred_element_type=f32)
+        b = jnp.dot(x.astype(jnp.float32), a)
+        return b.astype(jnp.bfloat16)
+
+    def stats_only(x):
+        # legal: f32 is the statistics dtype — softmax max/sum chain
+        xf = x.astype(jnp.float32)
+        m = jnp.max(xf, axis=-1, keepdims=True)
+        p = jnp.exp(xf - m)
+        return (p / jnp.sum(p, axis=-1, keepdims=True)).astype(x.dtype)
+
+    x = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    return [
+        TraceProgram(name="fixture/tpu501_bad",
+                     jaxpr=jax.make_jaxpr(leaky)(x),
+                     meta={"kind": "fixture", "bf16_region": True}),
+        TraceProgram(name="fixture/tpu501_ok",
+                     jaxpr=jax.make_jaxpr(stats_only)(x),
+                     meta={"kind": "fixture", "bf16_region": True}),
+        # same leak, bf16_region NOT declared -> pass must stay silent
+        TraceProgram(name="fixture/tpu501_unscoped",
+                     jaxpr=jax.make_jaxpr(leaky)(x),
+                     meta={"kind": "fixture"}),
+    ]

@@ -1,0 +1,37 @@
+"""TPU502 fixtures: the seeded donation regression — a step that declares
+``donate_argnums`` but whose outputs cannot alias the donated buffer —
+plus a healthy donating step as the negative."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.trace import TraceProgram
+
+
+def build_programs():
+    # THE SEEDED MISS: params donated, but the "updated params" come back
+    # bf16 while the donated buffer is f32 — no output shares the donated
+    # type, jax drops the donation at lowering, peak HBM doubles.  This
+    # is exactly the silent regression a multi-precision refactor of a
+    # TrainStep would introduce.
+    def bad_step(params, g):
+        new = jax.tree_util.tree_map(
+            lambda p, gg: (p - 0.1 * gg).astype(jnp.bfloat16), params, g)
+        return new
+
+    def good_step(params, g):
+        return jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg,
+                                      params, g)
+
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    grads = {"w": jnp.zeros((64, 64), jnp.float32)}
+    out = []
+    for name, fn in (("fixture/tpu502_donation_miss", bad_step),
+                     ("fixture/tpu502_ok", good_step)):
+        jitted = jax.jit(fn, donate_argnums=(0,))
+        out.append(TraceProgram(
+            name=name,
+            jaxpr=jax.make_jaxpr(jitted)(params, grads),
+            lowered_text=jitted.lower(params, grads).as_text(),
+            meta={"kind": "fixture",
+                  "donate_labels": {0: "params/w"}}))
+    return out

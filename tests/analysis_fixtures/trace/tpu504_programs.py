@@ -1,0 +1,39 @@
+"""TPU504 fixtures: a Pallas kernel whose BlockSpec working set overflows
+per-core VMEM (double-buffered 2048x2048 f32 tiles = 32 MiB each) and a
+comfortably-fitting sibling as the negative."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.analysis.trace import TraceProgram
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _call(block):
+    rows = block * 4
+
+    def fn(x):
+        return pl.pallas_call(
+            _kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((block, block), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((block, block), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, block), jnp.float32),
+        )(x)
+    return fn, jax.ShapeDtypeStruct((rows, block), jnp.float32)
+
+
+def build_programs():
+    big_fn, big_x = _call(2048)      # 2048*2048*4B*2(dbuf)*2(in+out) = 64 MiB
+    ok_fn, ok_x = _call(256)         # 256*256*4B*2*2 = 1 MiB
+    return [
+        TraceProgram(name="fixture/tpu504_oversized",
+                     jaxpr=jax.make_jaxpr(big_fn)(big_x),
+                     meta={"kind": "fixture"}),
+        TraceProgram(name="fixture/tpu504_ok",
+                     jaxpr=jax.make_jaxpr(ok_fn)(ok_x),
+                     meta={"kind": "fixture"}),
+    ]

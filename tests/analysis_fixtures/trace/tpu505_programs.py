@@ -1,0 +1,37 @@
+"""TPU505 fixtures: a dead matmul, a duplicated matmul and a stray
+``jax.debug.print`` (positive), and a clean program plus an
+``allow_callbacks`` program as negatives."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.trace import TraceProgram
+
+
+def build_programs():
+    def dirty(x):
+        dead = jnp.dot(x, x)            # result never used
+        y = x * 2.0
+        a = jnp.dot(x, y)               # computed twice, same inputs
+        b = jnp.dot(x, y)
+        jax.debug.print("step {}", a.sum())   # stray host callback
+        del dead
+        return a + b
+
+    def clean(x):
+        a = jnp.dot(x, x.T)
+        return a + a
+
+    x = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    return [
+        TraceProgram(name="fixture/tpu505_dirty",
+                     jaxpr=jax.make_jaxpr(dirty)(x),
+                     meta={"kind": "fixture"}),
+        TraceProgram(name="fixture/tpu505_ok",
+                     jaxpr=jax.make_jaxpr(clean)(x),
+                     meta={"kind": "fixture"}),
+        # same callback, but the program is REGISTERED as callback-bearing
+        # (e.g. a debug/profiling harness) -> only the dead/dup findings
+        TraceProgram(name="fixture/tpu505_callbacks_allowed",
+                     jaxpr=jax.make_jaxpr(dirty)(x),
+                     meta={"kind": "fixture", "allow_callbacks": True}),
+    ]

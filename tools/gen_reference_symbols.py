@@ -1,0 +1,59 @@
+"""Regenerate tools/reference_symbols.json — the per-subpackage public
+symbol snapshot the parity gate (tests/test_symbol_parity.py) diffs the
+live surface against.
+
+Run after INTENTIONALLY growing a namespace::
+
+    python tools/gen_reference_symbols.py
+
+The snapshot is a one-way ratchet: the gate fails when a recorded symbol
+disappears (a silent surface regression), never when new symbols appear —
+rerun this script to ratchet new surface in.
+"""
+import importlib
+import inspect
+import json
+import os
+import sys
+
+#: the subpackages whose symbol surface is pinned (VERDICT Next #7).
+TRACKED = ["nn", "nn.functional", "nn.utils", "static", "utils",
+           "incubate", "distribution", "vision"]
+
+
+def public_symbols(modname: str):
+    mod = importlib.import_module("paddle_tpu." + modname)
+    if getattr(mod, "__all__", None):
+        names = list(mod.__all__)
+    else:
+        names = [n for n in dir(mod) if not n.startswith("_")]
+    out = []
+    for n in sorted(set(names)):
+        try:
+            obj = getattr(mod, n)
+        except AttributeError:
+            continue
+        if inspect.ismodule(obj):
+            # own submodules ARE surface (vision.datasets, nn.functional);
+            # foreign modules (np, jax) leaking through dir() are not
+            if not getattr(obj, "__name__", "").startswith("paddle_tpu."):
+                continue
+        out.append(n)
+    return out
+
+
+def main():
+    snapshot = {m: public_symbols(m) for m in TRACKED}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "reference_symbols.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(snapshot, f, indent=1, sort_keys=True)
+        f.write("\n")
+    total = sum(len(v) for v in snapshot.values())
+    print("wrote %s: %d symbols over %d namespaces"
+          % (path, total, len(snapshot)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
