@@ -52,6 +52,7 @@ from . import device        # noqa: E402
 from . import framework     # noqa: E402
 from . import utils         # noqa: E402
 from . import incubate      # noqa: E402
+from . import robustness    # noqa: E402
 from . import fft           # noqa: E402
 from . import signal        # noqa: E402
 from . import linalg        # noqa: E402

@@ -18,6 +18,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..robustness.faultpoints import declare as _declare, faultpoint
+
+_declare("amp.found_inf",
+         "override the GradScaler's found-inf verdict (ForceFoundInf "
+         "simulates an fp16 overflow step without overflow-scale grads)")
 
 # Reference O1 lists (auto_cast.py): ops that are numerically safe + MXU-bound
 WHITE_LIST = {"matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d", "linear",
@@ -125,6 +130,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._last_skipped = False
         self._already_unscaled = set()
 
     def scale(self, var):
@@ -152,8 +158,16 @@ class GradScaler:
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
+            self._last_skipped = False
             return
         self.unscale_(optimizer)   # no-op if the user already unscaled
+        ctx = faultpoint("amp.found_inf", found_inf=self._found_inf)
+        if ctx is not None:
+            self._found_inf = bool(ctx["found_inf"])
+        # recorded BEFORE _update resets the flag: DivergenceSentinel reads
+        # this to tell "the fp16 gate already skipped the poisoned update"
+        # (params intact — no rewind needed) from a real divergence
+        self._last_skipped = self._found_inf
         if not self._found_inf:
             optimizer.step()
         self._already_unscaled.discard(id(optimizer))
@@ -182,6 +196,12 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+
+    @property
+    def last_step_skipped(self) -> bool:
+        """True iff the most recent ``step()`` skipped the optimizer update
+        because non-finite gradients were found (the fp16 overflow path)."""
+        return self._last_skipped
 
     def is_enable(self):
         return self._enable

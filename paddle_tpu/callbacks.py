@@ -15,7 +15,74 @@ from .hapi import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
                    ProgBarLogger)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
-           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "DivergenceMonitor"]
+
+
+class DivergenceMonitor(Callback):
+    """Watch the training loss through a
+    :class:`paddle_tpu.robustness.DivergenceSentinel` and roll the model's
+    compiled TrainStep back to the last good snapshot when it diverges
+    (NaN/Inf or a ``spike_factor``× spike over the rolling median).
+
+    hapi integration notes: the sentinel binds lazily to
+    ``model._train_step`` (built on the first train batch), and a rewind
+    restores parameters/optimizer/LR/RNG state but does NOT replay data
+    batches — fit() continues with the next batch, which is the right
+    trade for a callback (loops that need bit-identical replay drive the
+    sentinel directly, see ROBUSTNESS.md).  After ``max_rewinds`` rewinds
+    the monitor stops training (``model.stop_training``): a run that keeps
+    diverging needs a human, not an infinite rollback loop.
+    """
+
+    def __init__(self, monitor="loss", max_rewinds=3, **sentinel_kwargs):
+        super().__init__()
+        self.monitor = monitor
+        self.max_rewinds = max_rewinds
+        self._sentinel_kwargs = dict(sentinel_kwargs)
+        self._sentinel_kwargs.setdefault("snapshot_every", 10)
+        self._sentinel = None
+        self._step = 0
+        self.rewinds = 0
+
+    def _current(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0] if v else None
+        return None if v is None else float(v)
+
+    def on_train_batch_end(self, step, logs=None):
+        from .robustness.sentinel import DivergenceSentinel
+
+        train_step = getattr(self.model, "_train_step", None)
+        value = self._current(logs)
+        if train_step is None or value is None or \
+                getattr(self.model, "stop_training", False):
+            return
+        if self._sentinel is None or self._sentinel.train_step \
+                is not train_step:
+            self._sentinel = DivergenceSentinel(train_step,
+                                                **self._sentinel_kwargs)
+        self._step += 1
+        import sys
+
+        from .robustness.sentinel import DivergenceError
+        try:
+            rewound = self._sentinel.observe(self._step, value) is not None
+        except DivergenceError as e:
+            # ring exhausted (e.g. divergence before the first snapshot):
+            # a callback must stop training, not crash fit()
+            sys.stderr.write("DivergenceMonitor: %s — stopping training\n"
+                             % e)
+            self.model.stop_training = True
+            return
+        if rewound:
+            self.rewinds += 1
+            if self.rewinds >= self.max_rewinds:
+                sys.stderr.write(
+                    "DivergenceMonitor: %d rewind(s) exhausted — stopping "
+                    "training\n" % self.rewinds)
+                self.model.stop_training = True
 
 
 class ReduceLROnPlateau(Callback):

@@ -33,7 +33,16 @@ import sys
 import time
 from typing import List, Optional
 
+from ..robustness.faultpoints import declare as _declare, faultpoint
+from ..robustness.preemption import PREEMPTED_RC
+
 __all__ = ["main", "Launcher"]
+
+_declare("launch.respawn",
+         "fires before an elastic worker respawn (rc + local_rank in ctx)")
+
+#: crash-loop backoff ceiling — doubling stops here
+_MAX_RESTART_DELAY = 60.0
 
 
 def _parse(argv):
@@ -57,6 +66,12 @@ def _parse(argv):
                    help="restart dead workers (fleet/elastic semantics)")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--poll_interval", type=float, default=0.2)
+    p.add_argument("--restart_delay", type=float, default=1.0,
+                   help="base delay before an elastic respawn; doubled per "
+                        "consecutive fast failure (crash-loop backoff)")
+    p.add_argument("--healthy_interval", type=float, default=30.0,
+                   help="a worker alive at least this long resets its "
+                        "crash-loop backoff to --restart_delay")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -67,7 +82,8 @@ class Launcher:
 
     def __init__(self, nnodes=1, node_rank=0, nproc_per_node=1, master="",
                  ips="", log_dir="log", elastic=False, max_restarts=3,
-                 poll_interval=0.2):
+                 poll_interval=0.2, restart_delay=1.0,
+                 healthy_interval=30.0):
         self.nnodes = nnodes
         self.node_rank = node_rank
         self.nproc = nproc_per_node
@@ -81,10 +97,19 @@ class Launcher:
         self.elastic = elastic
         self.max_restarts = max_restarts
         self.poll_interval = poll_interval
+        self.restart_delay = restart_delay
+        self.healthy_interval = healthy_interval
         self.world_size = nnodes * nproc_per_node
         self._procs: List[Optional[subprocess.Popen]] = []
         self._logs: List = []
         self._restarts = [0] * nproc_per_node
+        # crash-loop backoff state: next respawn delay + last spawn time,
+        # per local worker; backoff_log records every applied delay (the
+        # chaos tests assert the doubling schedule from it)
+        self._delay = [restart_delay] * nproc_per_node
+        self._spawned_at = [0.0] * nproc_per_node
+        self.backoff_log: List[float] = []   # crash-backoff delays applied
+        self.preempt_respawns = 0            # budget-free preempt restarts
 
     # -- env wiring ---------------------------------------------------------
     def _worker_env(self, local_rank: int) -> dict:
@@ -120,6 +145,7 @@ class Launcher:
                    buffering=0)
         proc = subprocess.Popen(cmd, env=self._worker_env(local_rank),
                                 stdout=log, stderr=subprocess.STDOUT)
+        self._spawned_at[local_rank] = time.time()
         return proc, log
 
     def run(self, cmd: List[str]) -> int:
@@ -140,11 +166,36 @@ class Launcher:
                 except Exception:
                     pass
 
+    def _respawn(self, lr: int, cmd, rc: int):
+        faultpoint("launch.respawn", local_rank=lr, rc=rc)
+        p, log = self._start_one(lr, cmd)
+        self._procs[lr] = p
+        try:
+            # close the dead worker's log handle before replacing it —
+            # appending leaked one fd per restart across long elastic runs
+            self._logs[lr].close()
+        except Exception:
+            pass
+        self._logs[lr] = log
+
     def _supervise(self, cmd) -> int:
         live = set(range(self.nproc))
+        # lr -> (monotonic respawn deadline, rc): crash-loop backoff is a
+        # per-worker DEADLINE, not an inline sleep — supervision of every
+        # other worker (including "abort the job on a non-elastic death")
+        # keeps polling while one worker waits out its backoff
+        pending = {}
         while live:
             time.sleep(self.poll_interval)
+            now = time.monotonic()
+            for lr in sorted(pending):
+                when, rc = pending[lr]
+                if now >= when:
+                    del pending[lr]
+                    self._respawn(lr, cmd, rc)
             for lr in sorted(live):
+                if lr in pending:
+                    continue  # dead, waiting out its backoff
                 rc = self._procs[lr].poll()
                 if rc is None:
                     continue
@@ -152,21 +203,46 @@ class Launcher:
                     live.discard(lr)
                     continue
                 # worker death (reference: elastic watch → restart)
-                if self.elastic and self._restarts[lr] < self.max_restarts:
-                    self._restarts[lr] += 1
+                if self.elastic and rc == PREEMPTED_RC:
+                    # the worker drained an emergency checkpoint and left on
+                    # preemption notice — restart-eligible, NOT a crash: it
+                    # consumes no restart budget.  It still rides the
+                    # delay/doubling machinery (budget-free): a scheduler
+                    # draining the node SIGTERMs every fresh incarnation,
+                    # and an undelayed respawn loop would hammer the shared
+                    # checkpoint filesystem with emergency saves
+                    uptime = time.time() - self._spawned_at[lr]
+                    if uptime >= self.healthy_interval:
+                        self._delay[lr] = self.restart_delay
+                    delay = self._delay[lr]
+                    self.preempt_respawns += 1
                     sys.stderr.write(
-                        f"[launch] worker {lr} exited rc={rc}; elastic "
-                        f"restart {self._restarts[lr]}/{self.max_restarts}\n")
-                    p, log = self._start_one(lr, cmd)
-                    self._procs[lr] = p
-                    try:
-                        # close the dead worker's log handle before
-                        # replacing it — appending leaked one fd per
-                        # restart across long elastic runs
-                        self._logs[lr].close()
-                    except Exception:
-                        pass
-                    self._logs[lr] = log
+                        f"[launch] worker {lr} preempted (rc={rc}) after "
+                        f"{uptime:.1f}s; restarting in {delay:.1f}s "
+                        "without consuming restart budget\n")
+                    pending[lr] = (now + delay, rc)
+                    if uptime < self.healthy_interval:
+                        self._delay[lr] = min(delay * 2, _MAX_RESTART_DELAY)
+                elif self.elastic and self._restarts[lr] < self.max_restarts:
+                    uptime = time.time() - self._spawned_at[lr]
+                    if uptime >= self.healthy_interval:
+                        # it ran long enough to be considered healthy before
+                        # dying — not a crash loop; restart promptly
+                        self._delay[lr] = self.restart_delay
+                    self._restarts[lr] += 1
+                    delay = self._delay[lr]
+                    sys.stderr.write(
+                        f"[launch] worker {lr} exited rc={rc} after "
+                        f"{uptime:.1f}s; elastic restart "
+                        f"{self._restarts[lr]}/{self.max_restarts} in "
+                        f"{delay:.1f}s\n")
+                    self.backoff_log.append(delay)
+                    pending[lr] = (now + delay, rc)
+                    if uptime < self.healthy_interval:
+                        # consecutive fast failure: double toward the cap so
+                        # a crash-looping worker cannot hot-spin through
+                        # max_restarts (and hammer the store/cluster)
+                        self._delay[lr] = min(delay * 2, _MAX_RESTART_DELAY)
                 else:
                     sys.stderr.write(
                         f"[launch] worker {lr} exited rc={rc}; aborting job\n")
@@ -200,7 +276,9 @@ def main(argv=None) -> int:
         nnodes=args.nnodes, node_rank=args.node_rank,
         nproc_per_node=args.nproc_per_node, master=args.master,
         ips=args.ips, log_dir=args.log_dir, elastic=args.elastic,
-        max_restarts=args.max_restarts, poll_interval=args.poll_interval)
+        max_restarts=args.max_restarts, poll_interval=args.poll_interval,
+        restart_delay=args.restart_delay,
+        healthy_interval=args.healthy_interval)
     return launcher.run(cmd)
 
 

@@ -14,6 +14,24 @@ import time
 from typing import Optional
 
 from ..core import native as _native
+from ..robustness import retry as _retry
+from ..robustness.faultpoints import declare as _declare, faultpoint
+
+_declare("store.client_op",
+         "raise before a TCPStore client op (socket reset, transient IO)")
+
+
+class StoreReplyLostError(ConnectionError):
+    """A non-idempotent op's request reached the wire but the reply was
+    lost — the server MAY have applied it.  Never auto-retried (a blind
+    reissue of ``add`` would double-increment rendezvous counters and
+    desynchronize ``barrier``'s generation math); the caller decides."""
+
+
+def _store_timeout(default: float) -> float:
+    """PADDLE_TPU_STORE_TIMEOUT overrides every fixed store timeout
+    (wait/barrier) in one place."""
+    return _retry.env_float("PADDLE_TPU_STORE_TIMEOUT", default)
 
 
 class TCPStore:
@@ -25,6 +43,8 @@ class TCPStore:
         self.world_size = world_size
         self._server = None
         self._py_server = None
+        self._native_buf = None
+        self._native_buf_lock = threading.Lock()
         lib = _native.load()
         self._lib = lib
         if is_master:
@@ -44,58 +64,156 @@ class TCPStore:
         else:
             self._client = _PyStoreClient(host, port, timeout)
 
+    def _op(self, opname: str, fn):
+        """Every client op goes through one retry policy: transient socket
+        errors (reset/refused/timeout — real or injected at the
+        ``store.client_op`` faultpoint) are retried with jittered backoff,
+        reconnecting the pure-Python client's broken stream between
+        attempts.  Non-transient errors (KeyError, protocol bugs)
+        propagate immediately.  :class:`StoreReplyLostError` (an ``add``
+        whose request may already have been applied server-side) is
+        deliberately excluded from retry — reissuing it would
+        double-increment and desynchronize ``barrier``; it surfaces typed
+        so the caller can re-rendezvous instead."""
+        def attempt():
+            faultpoint("store.client_op", op=opname, store=self)
+            return fn()
+
+        def retryable(exc):
+            if isinstance(exc, StoreReplyLostError):
+                return False
+            return _retry.transient(exc)
+
+        def reconnect(exc, attempt_no, delay):
+            client = self._client
+            if isinstance(client, _PyStoreClient):
+                try:
+                    client.reconnect()
+                except OSError:
+                    pass  # next attempt surfaces the (still-broken) link
+
+        return _retry.retry_call(attempt, retry_on=retryable,
+                                 on_retry=reconnect,
+                                 name="TCPStore.%s" % opname)
+
     def set(self, key: str, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        if self._lib is not None:
-            rc = self._lib.tcp_store_set(self._client, key.encode(), data,
-                                         len(data))
-            if rc != 0:
-                raise RuntimeError("TCPStore.set failed")
-        else:
-            self._client.set(key, data)
+
+        def do_set():
+            if self._lib is not None:
+                rc = self._lib.tcp_store_set(self._client, key.encode(),
+                                             data, len(data))
+                if rc != 0:
+                    raise RuntimeError("TCPStore.set failed")
+            else:
+                self._client.set(key, data)
+        return self._op("set", do_set)
 
     def get(self, key: str, wait: bool = True) -> bytes:
-        if self._lib is not None:
-            import ctypes
-            cap = 1 << 20
-            buf = ctypes.create_string_buffer(cap)
-            n = self._lib.tcp_store_get(self._client, key.encode(), buf, cap,
-                                        1 if wait else 0)
-            if n == -1:
-                raise KeyError(key)
-            if n < 0:
-                raise RuntimeError("TCPStore.get failed")
-            return buf.raw[:n]
-        return self._client.get(key, wait)
+        def do_get():
+            if self._lib is not None:
+                import ctypes
+                n_cap = 1 << 20
+                if wait:
+                    # a wait=True get blocks server-side until the key
+                    # exists — it must NOT hold the shared buffer lock
+                    # (a concurrent barrier/wait poll would deadlock
+                    # behind it); a blocking get is rare, so a private
+                    # buffer per call is fine
+                    buf = ctypes.create_string_buffer(n_cap)
+                    return self._native_get(key, buf, n_cap, 1)
+                # non-blocking probes are the hot path (wait()/barrier()
+                # poll at up to 100 Hz per rank): reuse one cached buffer
+                # under the lock instead of a fresh 1 MiB per probe
+                with self._native_buf_lock:
+                    if self._native_buf is None:
+                        self._native_buf = ctypes.create_string_buffer(
+                            n_cap)
+                    return self._native_get(key, self._native_buf, n_cap,
+                                            0)
+            return self._client.get(key, wait)
+        return self._op("get", do_get)
+
+    def _native_get(self, key, buf, cap, wait_flag):
+        n = self._lib.tcp_store_get(self._client, key.encode(), buf, cap,
+                                    wait_flag)
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
 
     def add(self, key: str, amount: int = 1) -> int:
-        if self._lib is not None:
-            out = self._lib.tcp_store_add(self._client, key.encode(), amount)
-            if out == -(1 << 63):
-                raise RuntimeError("TCPStore.add failed")
-            return int(out)
-        return self._client.add(key, amount)
+        def do_add():
+            if self._lib is not None:
+                out = self._lib.tcp_store_add(self._client, key.encode(),
+                                              amount)
+                if out == -(1 << 63):
+                    raise RuntimeError("TCPStore.add failed")
+                return int(out)
+            return self._client.add(key, amount)
+        return self._op("add", do_add)
 
     def wait(self, keys, timeout: Optional[float] = None):
-        keys = keys if isinstance(keys, (list, tuple)) else [keys]
-        for k in keys:
-            self.get(k, wait=True)
+        """Block until every key exists.  Polls with exponential backoff
+        (0.01 s → 0.5 s) under a deadline — default 300 s, overridable per
+        call or via ``PADDLE_TPU_STORE_TIMEOUT`` — and the timeout error
+        NAMES the keys still missing (debugging "rank 3 never published
+        its endpoint" from a bare TimeoutError is guesswork)."""
+        keys = list(keys) if isinstance(keys, (list, tuple)) else [keys]
+        if timeout is None:
+            timeout = _store_timeout(300.0)
+        deadline = time.monotonic() + timeout
+        delays = _retry.backoff_delays(base=0.01, cap=0.5)
+        pending = list(keys)
+        while True:
+            pending = [k for k in pending if not self._has_key(k)]
+            if not pending:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    "TCPStore.wait timed out after %.1fs; keys still "
+                    "missing: %r (of %r) — override the deadline with "
+                    "PADDLE_TPU_STORE_TIMEOUT" % (timeout, pending, keys))
+            time.sleep(min(next(delays), remaining))
 
-    def barrier(self, key: str = "_barrier", timeout: float = 60.0):
-        """All world_size participants block until everyone arrived."""
+    def _has_key(self, key: str) -> bool:
+        try:
+            self.get(key, wait=False)
+            return True
+        except KeyError:
+            return False
+
+    def barrier(self, key: str = "_barrier",
+                timeout: Optional[float] = None):
+        """All world_size participants block until everyone arrived.
+        Polls with backoff (not a tight 0.01 s spin); the default 60 s
+        deadline honors ``PADDLE_TPU_STORE_TIMEOUT``; a timeout names the
+        generation key it was waiting on and how many peers arrived."""
+        if timeout is None:
+            timeout = _store_timeout(60.0)
         n = self.add(key + ":cnt", 1)
         target = self.world_size
         if n % target == 0:
             self.set(key + f":gen{n // target}", b"1")
         gen = (n + target - 1) // target
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            try:
-                self.get(key + f":gen{gen}", wait=False)
+        gen_key = key + f":gen{gen}"
+        deadline = time.monotonic() + timeout
+        delays = _retry.backoff_delays(base=0.01, cap=0.25)
+        while True:
+            if self._has_key(gen_key):
                 return
-            except KeyError:
-                time.sleep(0.01)
-        raise TimeoutError("TCPStore.barrier timed out")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                arrived = self.add(key + ":cnt", 0)  # read without bumping
+                raise TimeoutError(
+                    "TCPStore.barrier(%r) timed out after %.1fs waiting "
+                    "for key %r: %d arrival(s) total, generation %d needs "
+                    "%d — override the deadline with "
+                    "PADDLE_TPU_STORE_TIMEOUT"
+                    % (key, timeout, gen_key, arrived, gen, gen * target))
+            time.sleep(min(next(delays), remaining))
 
     def __del__(self):
         try:
@@ -180,16 +298,32 @@ class _PyStoreServer:
 
 class _PyStoreClient:
     def __init__(self, host, port, timeout):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = self._connect(timeout)
+
+    def _connect(self, timeout):
         deadline = time.time() + timeout
         while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
-                break
+                return socket.create_connection((self._host, self._port),
+                                                timeout=5)
             except OSError:
                 if time.time() > deadline:
                     raise
                 time.sleep(0.1)
-        self._lock = threading.Lock()
+
+    def reconnect(self):
+        """Drop the (possibly broken) stream and dial again — called by the
+        TCPStore retry policy between attempts after a transient error."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = self._connect(min(self._timeout, 5.0))
 
     def _read_full(self, n):
         buf = b""
@@ -223,4 +357,13 @@ class _PyStoreClient:
             self._sock.sendall(bytes([3]) + struct.pack("<I", len(kb)) + kb
                                + struct.pack("<I", 8)
                                + struct.pack("<q", amount))
-            return struct.unpack("<q", self._read_full(8))[0]
+            # the request is on the wire: from here the server may have
+            # applied the increment, so a lost reply must NOT be blindly
+            # reissued (StoreReplyLostError is excluded from retry)
+            try:
+                return struct.unpack("<q", self._read_full(8))[0]
+            except (ConnectionError, OSError) as e:
+                raise StoreReplyLostError(
+                    "TCPStore.add(%r, %d): reply lost after the request "
+                    "was sent — the increment may or may not have been "
+                    "applied; not reissuing" % (key, amount)) from e

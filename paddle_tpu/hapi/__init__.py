@@ -238,7 +238,12 @@ class Model:
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
                 it_count += 1
-                if num_iters and it_count >= num_iters:
+                # stop_training is honored PER BATCH: a callback tripping
+                # mid-epoch (e.g. DivergenceMonitor with its rollback ring
+                # exhausted) must not keep training — and then checkpoint —
+                # a contaminated state for the rest of a long epoch
+                if self.stop_training or (num_iters and
+                                          it_count >= num_iters):
                     break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0)

@@ -22,21 +22,85 @@ fast-forwards the data stream through ``ResumableIterator``.
 """
 from __future__ import annotations
 
+import atexit
+import hashlib
+import json
 import os
 import pickle
 import queue
 import re
 import shutil
+import sys
 import threading
 import time
+import warnings
+import weakref
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...robustness import retry as _retry
+from ...robustness.faultpoints import declare as _declare, faultpoint
 
-__all__ = ["CheckpointManager", "ResumableIterator", "TrainEpochRange"]
+__all__ = ["CheckpointManager", "ResumableIterator", "TrainEpochRange",
+           "CheckpointWriteError", "CheckpointCorruptionError",
+           "NoUsableCheckpointError", "CheckpointFallbackWarning"]
+
+_declare("checkpoint.shard_write",
+         "raise before a host's shard pickle hits disk (ENOSPC, EIO)")
+_declare("checkpoint.shard_file",
+         "mutate the landed shard file pre-publish (torn write, bit rot)")
+_declare("checkpoint.publish",
+         "raise/crash between shard verification and the DONE marker")
+_declare("checkpoint.restore_read",
+         "mutate/raise before a shard file is read back at restore")
+_declare("train.epoch",
+         "TrainEpochRange epoch boundary (Preempt here simulates SIGTERM "
+         "between epochs)")
+
+
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint could not be safely published (missing/short shard
+    after the write barrier).  The step directory holds no DONE marker."""
+
+
+class CheckpointCorruptionError(ValueError):
+    """A published checkpoint failed integrity verification on restore
+    (manifest sha256/size mismatch, unpicklable payload, missing shard)."""
+
+
+class NoUsableCheckpointError(FileNotFoundError):
+    """No checkpoint (of those requested) could be restored.  Subclasses
+    FileNotFoundError so pre-hardening callers' handlers keep working."""
+
+
+class CheckpointFallbackWarning(UserWarning):
+    """Emitted when restore skips a corrupt checkpoint for an older one."""
+
+
+# -- interpreter-exit flush --------------------------------------------------
+# The async writer is intentionally a daemon thread (a wedged NFS write must
+# not block interpreter exit forever), so queued saves would silently die
+# with the process.  Every live manager registers here and is close()d —
+# queue drained, on the caller thread if need be — by one atexit hook.
+_live_managers: "weakref.WeakSet[CheckpointManager]" = weakref.WeakSet()
+_STOP = object()
+
+
+def _flush_managers_at_exit():
+    for mgr in list(_live_managers):
+        try:
+            mgr.close()
+        except BaseException as e:  # the process is exiting: report, go on
+            sys.stderr.write(
+                "[checkpoint] flush of %r at interpreter exit failed: %r\n"
+                % (getattr(mgr, "directory", "?"), e))
+            sys.stderr.flush()
+
+
+atexit.register(_flush_managers_at_exit)
 
 
 # --------------------------------------------------------------------------
@@ -123,6 +187,22 @@ def _from_host(obj, template=None):
 # manager
 # --------------------------------------------------------------------------
 
+class _HashingWriter:
+    """File-like pass-through that sha256s and counts what pickle streams
+    through it — the manifest's view of the intended shard bytes, with no
+    full in-memory serialized copy."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, data):
+        self.sha.update(data)
+        self.nbytes += len(data)
+        return self._f.write(data)
+
+
 class CheckpointManager:
     """Directory of ``ckpt-<step>`` checkpoints with async sharded save,
     atomic publish, retention, and newest-complete restore.
@@ -149,15 +229,21 @@ class CheckpointManager:
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._err: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
+        self._closed = False
         if async_save:
             self._worker = threading.Thread(target=self._drain, daemon=True)
             self._worker.start()
+        _live_managers.add(self)
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: Any, wait: bool = False):
         """Snapshot ``state`` (any pytree of Tensors/arrays/py data) as
         checkpoint ``step``.  Device arrays are fetched now; IO happens on
         the writer thread unless ``wait`` or ``async_save=False``."""
+        if self._closed:
+            raise RuntimeError(
+                "CheckpointManager(%r) is closed — no further saves"
+                % self.directory)
         if self._err is not None:
             err, self._err = self._err, None
             raise RuntimeError("previous async checkpoint failed") from err
@@ -177,6 +263,9 @@ class CheckpointManager:
     def _drain(self):
         while True:
             item = self._q.get()
+            if item is _STOP:
+                self._q.task_done()
+                return
             if item is None:
                 self._q.task_done()
                 continue
@@ -188,6 +277,10 @@ class CheckpointManager:
             finally:
                 self._q.task_done()
 
+    @staticmethod
+    def _manifest_name(host: int) -> str:
+        return f"host-{host}.manifest.json"
+
     def _write(self, step: int, payload):
         final = os.path.join(self.directory, f"ckpt-{step}")
         tmp = final + ".tmp"
@@ -198,40 +291,81 @@ class CheckpointManager:
         # it — otherwise host 0's rmtree can delete a peer's shard file
         self._barrier(f"ckpt-clean-{step}")
         os.makedirs(tmp, exist_ok=True)
-        with open(os.path.join(tmp, f"host-{self._host}.ckpt"), "wb") as f:
-            pickle.dump(payload, f, protocol=4)
+        shard = os.path.join(tmp, f"host-{self._host}.ckpt")
+        faultpoint("checkpoint.shard_write", path=shard, step=step)
+        # the manifest must describe the INTENDED bytes (a write torn
+        # between here and publish then no longer hashes to it), but
+        # materializing pickle.dumps() in RAM would double peak host
+        # memory at the worst moment (the emergency preemption save of a
+        # multi-GB state) — so hash/count in-line as pickle streams out
+        with open(shard, "wb") as f:
+            writer = _HashingWriter(f)
+            pickle.dump(payload, writer, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())  # durable before the barrier says "written"
+        with open(os.path.join(tmp, self._manifest_name(self._host)),
+                  "w") as f:
+            json.dump({"sha256": writer.sha.hexdigest(),
+                       "nbytes": writer.nbytes,
+                       "host": self._host, "step": step}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faultpoint("checkpoint.shard_file", path=shard, step=step)
         # every host's shard file must be durably in tmp before host 0
         # publishes (renames + DONE)
         self._barrier(f"ckpt-written-{step}")
         if self._host == 0:
-            # verify every host's shard landed in the SHARED directory
-            # before publishing — catches a per-host-local-disk
-            # misconfiguration at save time instead of at restore.
-            # open() (not os.path.exists) + a short retry: NFS negative
-            # dentry caching can report a peer's just-written file absent
-            # within the attribute-cache window
-            def shard_visible(path, tries=10, delay=0.5):
-                for _ in range(tries):
-                    try:
-                        with open(path, "rb"):
-                            return True
-                    except OSError:
-                        time.sleep(delay)
-                return False
-
-            missing = [i for i in range(self._nhosts)
-                       if not shard_visible(
-                           os.path.join(tmp, f"host-{i}.ckpt"))]
-            if missing:
-                raise RuntimeError(
-                    "checkpoint %s: shard files for hosts %r are absent "
-                    "after the write barrier — the checkpoint directory "
-                    "must be one shared filesystem visible to all hosts"
-                    % (final, missing))
+            self._verify_shards_before_publish(tmp, final)
+            faultpoint("checkpoint.publish", path=final, step=step)
             os.replace(tmp, final)
             with open(os.path.join(final, "DONE"), "w") as f:
                 f.write(str(self._nhosts))
             self._retain()
+
+    def _verify_shards_before_publish(self, tmp: str, final: str):
+        """Host 0, pre-DONE: every peer shard must be present in the SHARED
+        directory AND match its manifest's size.  Catches both a
+        per-host-local-disk misconfiguration and a torn shard write at save
+        time instead of at restore — a checkpoint that fails here is never
+        published.  open() (not os.path.exists) + retry with backoff: NFS
+        negative dentry caching can report a peer's just-written file
+        absent within the attribute-cache window."""
+        def stat_visible(path):
+            def attempt():
+                with open(path, "rb"):
+                    return os.path.getsize(path)
+            try:
+                return _retry.retry_call(
+                    attempt, retry_on=OSError, tries=8, base_delay=0.05,
+                    max_delay=1.0, deadline=5.0,
+                    name="checkpoint.shard_visible")
+            except _retry.RetryError:
+                return None
+
+        missing, torn = [], []
+        for i in range(self._nhosts):
+            size = stat_visible(os.path.join(tmp, f"host-{i}.ckpt"))
+            if size is None:
+                missing.append(i)
+                continue
+            try:
+                with open(os.path.join(tmp, self._manifest_name(i))) as f:
+                    want = int(json.load(f)["nbytes"])
+            except (OSError, ValueError, KeyError):
+                missing.append(i)  # no readable manifest: not verifiable
+                continue
+            if size != want:
+                torn.append((i, size, want))
+        if missing or torn:
+            raise CheckpointWriteError(
+                "checkpoint %s NOT published: %s%s — the checkpoint "
+                "directory must be one shared filesystem and every shard "
+                "write must complete"
+                % (final,
+                   ("shard/manifest files for hosts %r absent after the "
+                    "write barrier" % missing) if missing else "",
+                   ("; torn shard writes %s (host, bytes-on-disk, "
+                    "bytes-expected)" % torn) if torn else ""))
 
     def _barrier(self, tag):
         if self._nhosts > 1:
@@ -253,6 +387,67 @@ class CheckpointManager:
             err, self._err = self._err, None
             raise RuntimeError("async checkpoint failed") from err
 
+    #: total budget for close(): generous for a healthy-but-slow flush of
+    #: the (maxsize-2) queue, but a hard bound — a wedged NFS write must
+    #: not stall interpreter exit forever (the reason the writer is a
+    #: daemon thread in the first place)
+    _CLOSE_TIMEOUT = 600.0
+
+    def close(self):
+        """Flush queued saves and shut the writer down, bounded by
+        ``_CLOSE_TIMEOUT`` total.  Idempotent; called automatically at
+        interpreter exit for every live manager, so an async ``save()``
+        immediately followed by process exit still lands on disk.  Raises
+        if a queued save failed during the flush; warns (stderr) if the
+        flush could not complete inside the budget."""
+        if self._closed:
+            return
+        self._closed = True
+        deadline = time.monotonic() + self._CLOSE_TIMEOUT
+        worker, self._worker = self._worker, None
+        if worker is not None and worker.is_alive():
+            try:
+                self._q.put(_STOP, timeout=max(
+                    0.0, deadline - time.monotonic()))
+            except queue.Full:
+                pass  # wedged/busy writer: fall through to the drainer
+            worker.join(timeout=max(0.0, deadline - time.monotonic()))
+        # anything the worker did not get to (it was never started, died,
+        # or the join timed out) is drained on a FRESH daemon thread with
+        # a bounded join — _write on a wedged filesystem can block
+        # indefinitely, and close() (atexit!) must not
+        drainer = threading.Thread(target=self._drain_remaining,
+                                   daemon=True)
+        drainer.start()
+        drainer.join(timeout=max(0.1, deadline - time.monotonic()))
+        if drainer.is_alive() or (worker is not None and worker.is_alive()):
+            sys.stderr.write(
+                "[checkpoint] close(%r) exceeded its %.0fs budget with "
+                "~%d save(s) unflushed — the filesystem is wedged; those "
+                "checkpoints are lost (older complete checkpoints remain "
+                "restorable)\n"
+                % (self.directory, self._CLOSE_TIMEOUT, self._q.qsize()))
+            sys.stderr.flush()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                "async checkpoint failed during close") from err
+
+    def _drain_remaining(self):
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if item is not _STOP and item is not None:
+                    step, payload = item
+                    self._write(step, payload)
+            except BaseException as e:
+                self._err = e
+            finally:
+                self._q.task_done()
+
     # -- restore ------------------------------------------------------------
     def all_steps(self):
         out = []
@@ -267,15 +462,56 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: Optional[int] = None, template: Any = None):
+    def restore(self, step: Optional[int] = None, template: Any = None,
+                fallback: Optional[bool] = None):
         """Load checkpoint ``step`` (default: newest complete).  ``template``
         — a like-shaped pytree whose jax.Array leaves carry target shardings
-        — re-places restored arrays onto those shardings."""
+        — re-places restored arrays onto those shardings.
+
+        ``fallback`` (default: True when ``step`` is None, False when a
+        step is named): on a corrupt/torn/unpicklable checkpoint, warn
+        loudly (:class:`CheckpointFallbackWarning`) and try the next-older
+        complete checkpoint instead of raising on the first bad one.  Only
+        :class:`NoUsableCheckpointError` escapes a fallback-enabled
+        restore with candidates, and it names every failure."""
         if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no complete checkpoint in {self.directory}")
+            candidates = list(reversed(self.all_steps()))
+            if fallback is None:
+                fallback = True
+        else:
+            candidates = [step]
+            if fallback is None:
+                fallback = False
+        if not candidates:
+            raise NoUsableCheckpointError(
+                f"no complete checkpoint in {self.directory}")
+        merged, failures = None, []
+        for s in candidates:
+            try:
+                merged = self._read_step(s)
+                break
+            except Exception as e:
+                if not fallback:
+                    raise
+                failures.append((s, e))
+                warnings.warn(
+                    "checkpoint ckpt-%d in %s is unusable (%s: %s) — "
+                    "falling back to an older checkpoint"
+                    % (s, self.directory, type(e).__name__, e),
+                    CheckpointFallbackWarning, stacklevel=2)
+        if merged is None:
+            raise NoUsableCheckpointError(
+                "no usable checkpoint in %s — every candidate failed: %s"
+                % (self.directory,
+                   "; ".join("ckpt-%d: %s: %s" % (s, type(e).__name__, e)
+                             for s, e in failures)))
+        tmpl = _to_template(template) if template is not None else None
+        return _from_host(merged, tmpl)
+
+    def _read_step(self, step: int):
+        """Read + integrity-verify + merge one checkpoint's shard files.
+        Raises :class:`CheckpointCorruptionError` on any manifest mismatch
+        or unpicklable payload; transient read errors are retried."""
         d = os.path.join(self.directory, f"ckpt-{step}")
         with open(os.path.join(d, "DONE")) as f:
             expected_hosts = int(f.read().strip() or 1)
@@ -285,17 +521,62 @@ class CheckpointManager:
             if not name.endswith(".ckpt"):
                 continue
             n_files += 1
-            with open(os.path.join(d, name), "rb") as f:
-                part = pickle.load(f)
+            path = os.path.join(d, name)
+            faultpoint("checkpoint.restore_read", path=path, step=step)
+
+            def read_bytes(p=path):
+                with open(p, "rb") as f:
+                    return f.read()
+
+            blob = _retry.retry_call(read_bytes, retry_on=_retry.transient,
+                                     tries=4, base_delay=0.05,
+                                     name="checkpoint.restore_read")
+            self._verify_blob(d, name, blob)
+            try:
+                part = pickle.loads(blob)
+            except Exception as e:
+                raise CheckpointCorruptionError(
+                    "checkpoint shard %s/%s is unpicklable: %r"
+                    % (d, name, e)) from e
             merged = part if merged is None else _merge_shards(merged, part)
         if merged is None:
-            raise FileNotFoundError(f"checkpoint {d} has no payload files")
+            raise NoUsableCheckpointError(
+                f"checkpoint {d} has no payload files")
         if n_files != expected_hosts:
-            raise ValueError(
+            raise CheckpointCorruptionError(
                 f"checkpoint {d} has {n_files} host files but was written "
                 f"by {expected_hosts} hosts — incomplete or corrupted")
-        tmpl = _to_template(template) if template is not None else None
-        return _from_host(merged, tmpl)
+        return merged
+
+    @staticmethod
+    def _verify_blob(d: str, name: str, blob: bytes):
+        """Check shard bytes against the sha256 manifest written at save
+        time.  Checkpoints from before the manifest era verify vacuously
+        (restore stays backward-compatible); a manifest that exists but
+        does not match is a hard CheckpointCorruptionError."""
+        host = name[len("host-"):-len(".ckpt")] if name.startswith("host-") \
+            else None
+        mpath = os.path.join(d, f"host-{host}.manifest.json") if host \
+            else None
+        if mpath is None or not os.path.exists(mpath):
+            return
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            want_sha, want_n = manifest["sha256"], int(manifest["nbytes"])
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorruptionError(
+                "checkpoint manifest %s is unreadable: %r" % (mpath, e)
+            ) from e
+        if len(blob) != want_n:
+            raise CheckpointCorruptionError(
+                "checkpoint shard %s/%s is torn: %d bytes on disk, "
+                "manifest recorded %d" % (d, name, len(blob), want_n))
+        got_sha = hashlib.sha256(blob).hexdigest()
+        if got_sha != want_sha:
+            raise CheckpointCorruptionError(
+                "checkpoint shard %s/%s is corrupt: sha256 %s != manifest "
+                "%s" % (d, name, got_sha, want_sha))
 
 
 def _merge_shards(a, b):
@@ -375,13 +656,22 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num: int, name: str = "default",
                  checkpoint_dir: Optional[str] = None, save_interval: int = 1,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, preemption_guard=None):
         checkpoint_dir = checkpoint_dir or os.environ.get(
             "PADDLE_TPU_CHECKPOINT_DIR", f"./checkpoints/{name}")
         self.manager = CheckpointManager(checkpoint_dir,
                                          max_to_keep=max_to_keep)
         self.max_epoch_num = max_epoch_num
         self.save_interval = save_interval
+        # preemption_guard=True installs a fresh SIGTERM/SIGUSR1 guard
+        # (PADDLE_TPU_PREEMPTION_SIGNAL); a PreemptionGuard instance is
+        # used as-is.  On notice, the epoch boundary drains an emergency
+        # SYNCHRONOUS checkpoint and exits with PREEMPTED_RC — the rc the
+        # elastic launcher treats as restart-eligible, not a crash.
+        if preemption_guard is True:
+            from ...robustness.preemption import PreemptionGuard
+            preemption_guard = PreemptionGuard()
+        self.preemption_guard = preemption_guard
         self._getters: Dict[str, Callable[[], Any]] = {}
         self._setters: Dict[str, Callable[[Any], None]] = {}
         self._start_epoch = 0
@@ -405,9 +695,17 @@ class TrainEpochRange:
 
     def get(self):
         from ...core import get_rng_state, set_rng_state
-        step = self.manager.latest_step()
-        if step is not None:
-            payload = self.manager.restore(step)
+        # restore() WITHOUT a step: auto-resume must ride the
+        # newest→older corruption fallback — naming latest_step() here
+        # would pin resume to the newest checkpoint and fail the job on
+        # the exact bit-rot the fallback exists to survive.  (No complete
+        # checkpoint at all => fresh start; checkpoints present but ALL
+        # unusable => NoUsableCheckpointError propagates — silently
+        # retraining from scratch would be worse than failing.)
+        payload = None
+        if self.manager.latest_step() is not None:
+            payload = self.manager.restore()
+        if payload is not None:
             self._start_epoch = int(payload["epoch"]) + 1
             for name, setter in self._setters.items():
                 if name in payload["state"]:
@@ -417,13 +715,29 @@ class TrainEpochRange:
         try:
             for epoch in range(self._start_epoch, self.max_epoch_num):
                 yield epoch
-                if (epoch - self._start_epoch) % self.save_interval == 0 or \
-                        epoch == self.max_epoch_num - 1:
+                faultpoint("train.epoch", epoch=epoch)
+                guard = self.preemption_guard
+                preempted = guard is not None and guard.preempted
+                if preempted or \
+                        (epoch - self._start_epoch) % self.save_interval \
+                        == 0 or epoch == self.max_epoch_num - 1:
+                    # on preemption the save is SYNCHRONOUS (wait=True):
+                    # the grace window is short and an async save queued
+                    # behind a slow write could be lost with the process
                     self.manager.save(epoch, {
                         "epoch": epoch,
                         "state": {n: g() for n, g in self._getters.items()},
                         "rng": get_rng_state(),
-                    })
+                    }, wait=preempted)
+                if preempted:
+                    from ...robustness.preemption import PREEMPTED_RC
+                    sys.stderr.write(
+                        "[checkpoint] preemption notice: emergency "
+                        "checkpoint for epoch %d drained to %s; exiting "
+                        "rc=%d (restart-eligible)\n"
+                        % (epoch, self.manager.directory, PREEMPTED_RC))
+                    sys.stderr.flush()
+                    raise SystemExit(PREEMPTED_RC)
         finally:
             # drain queued saves even if the caller breaks out early — the
             # daemon writer thread dies with the interpreter otherwise

@@ -26,6 +26,11 @@ from ..core import random as _rnd
 from ..core.grad_mode import no_grad
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+from ..robustness.faultpoints import declare as _declare, faultpoint
+
+_declare("train.grads",
+         "mutate the host-side batch before the compiled step (NaNBatch "
+         "here yields NaN loss + NaN grads at a chosen step)")
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "save", "load", "TranslatedLayer"]
@@ -461,6 +466,7 @@ class TrainStep:
                           for dt, g0, g1, m in groups))
         self.opt_state = optimizer.init_state(self.params)
         self._dirty = True
+        self._step_index = -1  # host-side step counter (faultpoint ctx)
 
         # ---- ZeRO placement (reference semantics: sharding_stage2.py:43
         # grad reduce-scatter, sharding_stage3.py:50 param slicing;
@@ -618,6 +624,15 @@ class TrainStep:
         rng = _rnd.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch_a = _unwrap_tree(batch)
+        # chaos hook: fires per step on the HOST side (a faultpoint inside
+        # the jitted step_fn would be traced away); a NaNBatch action
+        # poisons one input so loss and every grad behind it go NaN —
+        # the deterministic "NaN grads at step k" injection
+        self._step_index += 1
+        ctx = faultpoint("train.grads", batch=batch_a,
+                         step=self._step_index)
+        if ctx is not None:
+            batch_a = ctx["batch"]
         if self._in_shardings is not None and self._mesh is not None:
             from jax.sharding import NamedSharding
             specs = self._in_shardings

@@ -1,0 +1,37 @@
+"""paddle_tpu.robustness — chaos-hardened training infrastructure.
+
+Four pieces, documented in ROBUSTNESS.md:
+
+* :mod:`.faultpoints` — deterministic fault injection: named sites
+  compiled into the production checkpoint/store/launch/jit/amp paths
+  (no-op when disabled), driven by a seeded :class:`FaultPlan` under
+  ``chaos(plan)`` so every recovery path is unit-testable.
+* :mod:`.retry` — jittered exponential backoff with deadline and a typed
+  :class:`RetryError`; the shared policy behind store client ops and
+  checkpoint IO.
+* :mod:`.preemption` — :class:`PreemptionGuard` (SIGTERM/SIGUSR1 →
+  boundary-checked flag) and :data:`PREEMPTED_RC`, the restart-eligible
+  exit code the elastic launcher recognizes.
+* :mod:`.sentinel` — :class:`DivergenceSentinel`: NaN/Inf + loss-spike
+  detection over a bounded ring of host-side snapshots, with bit-identical
+  rollback.
+
+Everything here is stdlib-only at import time (jax is touched lazily), so
+any layer of the stack can depend on it without cycles.
+"""
+from . import faultpoints  # noqa: F401
+from . import retry  # noqa: F401
+from . import preemption  # noqa: F401
+from . import sentinel  # noqa: F401
+from .faultpoints import FaultPlan, chaos, declare, faultpoint  # noqa: F401
+from .preemption import PREEMPTED_RC, PreemptionGuard  # noqa: F401
+from .retry import RetryError, retry_call, retrying  # noqa: F401
+from .sentinel import DivergenceError, DivergenceSentinel  # noqa: F401
+
+__all__ = [
+    "faultpoints", "retry", "preemption", "sentinel",
+    "FaultPlan", "chaos", "declare", "faultpoint",
+    "PREEMPTED_RC", "PreemptionGuard",
+    "RetryError", "retry_call", "retrying",
+    "DivergenceError", "DivergenceSentinel",
+]
