@@ -1,0 +1,114 @@
+"""Benchmark: serving decode throughput through the slotted-cache engine.
+
+Prints ONE JSON line (the BENCH_decode_* trajectory format, next to the
+training one from bench.py):
+
+  {"metric": "decode_tokens_per_sec", "value": N, "unit": "tok/s",
+   "ttft_ms": ..., "tpot_ms": ..., "compile_counts": {...}, ...}
+
+Protocol: submit `requests` prompts through the continuous-batching
+scheduler at `num_slots` concurrency and time the full drain.  Decode
+throughput counts every generated token (first tokens, which are
+prefill work, are reported separately via TTFT).  `compile_counts`
+asserts the structural claim this engine exists for: the decode step
+compiles EXACTLY ONCE no matter how many tokens are generated or how
+slots churn.
+
+On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
+On CPU: the tiny config, so the bench always runs (numbers are smoke
+only).  Knobs: PADDLE_TPU_BENCH_SLOTS / _PROMPT / _NEW / _REQUESTS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+
+    on_tpu = jax.default_backend() == "tpu"
+    paddle.seed(0)
+
+    if on_tpu:
+        cfg = GPTConfig.gpt2_medium()
+        num_slots, prompt_len, max_new, requests = 8, 128, 128, 24
+        max_len = 1024
+    else:  # CPU smoke config so bench_decode.py always runs
+        cfg = GPTConfig.tiny()
+        num_slots, prompt_len, max_new, requests = 4, 12, 16, 8
+        max_len = 128
+    num_slots = int(os.getenv("PADDLE_TPU_BENCH_SLOTS", num_slots))
+    prompt_len = int(os.getenv("PADDLE_TPU_BENCH_PROMPT", prompt_len))
+    max_new = int(os.getenv("PADDLE_TPU_BENCH_NEW", max_new))
+    requests = int(os.getenv("PADDLE_TPU_BENCH_REQUESTS", requests))
+
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    model.eval()
+
+    engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
+                          seed=0)
+    rng = np.random.default_rng(0)
+
+    def drive(n_requests):
+        sched = ContinuousBatchingScheduler(engine)
+        for _ in range(n_requests):
+            sched.submit(Request(
+                prompt=rng.integers(0, cfg.vocab_size, (prompt_len,)),
+                max_new_tokens=max_new, temperature=0.0))
+        t0 = time.perf_counter()
+        results = sched.run()
+        return results, time.perf_counter() - t0
+
+    # warmup drain: compiles prefill (one bucket) + the decode step once
+    drive(min(num_slots, requests))
+    engine.reset()
+
+    results, dt = drive(requests)
+    total_tokens = sum(r.tokens.size for r in results.values())
+    ttft_ms = 1e3 * float(np.mean([r.ttft for r in results.values()]))
+    tpot_ms = 1e3 * float(np.mean(
+        [r.tpot for r in results.values() if r.tokens.size > 1]))
+
+    from paddle_tpu.kernels import autotune as at
+    result = {
+        "metric": "decode_tokens_per_sec",
+        "value": round(total_tokens / dt, 2),
+        "unit": "tok/s",
+        "ttft_ms": round(ttft_ms, 3),
+        "tpot_ms": round(tpot_ms, 3),
+        "total_tokens": total_tokens,
+        "wall_s": round(dt, 3),
+        "compile_counts": {
+            "decode": engine.decode_compile_count,
+            "prefill": engine.prefill_compile_count,
+        },
+        "config": {
+            "model": "gpt2_345m" if on_tpu else "tiny",
+            "backend": jax.default_backend(),
+            "num_slots": num_slots, "max_len": max_len,
+            "prompt_len": prompt_len, "max_new_tokens": max_new,
+            "requests": requests,
+        },
+        "autotune": at.report(),
+    }
+    assert result["compile_counts"]["decode"] == 1, \
+        "decode step recompiled: %r" % (result["compile_counts"],)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,8 +9,11 @@ registry pins the repo's compiled entry points the way
   params/buffers/opt_state);
 * ``pipeline_1f1b`` — the shard_map'd 1F1B step with an SGD update over a
   ('pp',) mesh (``paddle_tpu.distributed.pipeline.canonical_1f1b_step``);
-* ``gpt_decode`` — the KV-cache one-token decode step of the inference
-  artifact (prefill eagerly, trace the cached decode);
+* ``gpt_decode`` — the model-level one-token decode step over the STATIC
+  slotted KV cache (prefill eagerly, trace the cached decode);
+* ``serving/decode_step`` / ``serving/prefill`` — the serving engine's
+  batched continuous-batching iteration (cache buffers donated — TPU502
+  checks the aliasing materializes) and its bucketed prefill;
 * ``pallas/<family>/<variant>`` — every registered Pallas kernel variant,
   traced at the bench-standard key in bf16 (``bf16_region`` metadata set,
   so TPU501 audits the variants' f32 usage against F32_ACCUM_OPS).
@@ -167,25 +170,65 @@ def _build_gpt_decode() -> List[TraceProgram]:
     model.eval()
     prompt = Tensor(jnp.asarray(np.random.RandomState(0).randint(
         0, cfg.vocab_size, (1, 8)).astype(np.int32)))
-    # eager prefill fills the KV cache; the traced program is the
-    # per-token cached decode the serving loop runs
-    _logits, cache = model(prompt, cache=model.gen_cache(1))
-    cache_arrays = [(k._array, v._array) for k, v in cache]
+    # eager prefill fills the STATIC slotted cache (a registered pytree —
+    # it crosses the jit boundary directly); the traced program is the
+    # model-level per-token cached decode, whose shape no longer depends
+    # on how many tokens were generated
+    _logits, cache = model(prompt, cache=model.gen_cache(1, max_len=64))
     state = model.functional_state()
 
     def decode_step(state, x, cache):
-        cache_t = [(Tensor(k), Tensor(v)) for k, v in cache]
         (logits, new_cache), _ = functional_call(
-            model, state, Tensor(x), cache=cache_t)
+            model, state, Tensor(x), cache=cache)
         return logits, new_cache
 
     x1 = jnp.asarray(np.full((1, 1), 7, np.int32))
     jitted = jax.jit(decode_step)
-    jaxpr = jax.make_jaxpr(jitted)(state, x1, cache_arrays)
-    lowered = jitted.lower(state, x1, cache_arrays)
+    jaxpr = jax.make_jaxpr(jitted)(state, x1, cache)
+    lowered = jitted.lower(state, x1, cache)
     return [TraceProgram(
         name="gpt_decode", jaxpr=jaxpr, lowered_text=lowered.as_text(),
         meta={"kind": "decode", "mesh_axes": {}})]
+
+
+@register_builder("serving", prefix="serving/")
+def _build_serving() -> List[TraceProgram]:
+    """The serving engine's two compiled entry points at a tiny config:
+    ``serving/decode_step`` (the batched, donation-aliased continuous-
+    batching iteration — TPU502 verifies the KV-cache donation actually
+    materializes as input/output aliasing) and ``serving/prefill`` (the
+    smallest bucket)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    engine = DecodeEngine(model, num_slots=2, max_len=64)
+    out: List[TraceProgram] = []
+    for name, fn, donate, args in (
+            ("serving/decode_step", engine._decode_fn,
+             engine._decode_donate_argnums, engine.decode_trace_args()),
+            ("serving/prefill", engine._prefill_fn,
+             engine._prefill_donate_argnums,
+             engine.prefill_trace_args())):
+        # keep_unused=True for the AUDIT wrap only (same rationale as the
+        # train step): pruning would misalign the entry's argument
+        # indices against the jaxpr's donation flags.  x64_scope(False)
+        # matches the production trace scope (engine.prefill/decode) so
+        # the audited program is the program that runs.
+        from paddle_tpu.core.dtype import x64_scope
+        audit = jax.jit(fn, donate_argnums=donate, keep_unused=True)
+        with x64_scope(False):
+            jaxpr = jax.make_jaxpr(audit)(*args)
+            lowered = audit.lower(*args)
+        out.append(TraceProgram(
+            name=name, jaxpr=jaxpr, lowered_text=lowered.as_text(),
+            meta={"kind": "serving", "mesh_axes": {},
+                  "donate_labels": _donate_labels(args)}))
+    return out
 
 
 @register_builder("pallas_kernels", prefix="pallas/")

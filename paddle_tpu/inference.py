@@ -104,9 +104,23 @@ class Tensor:
 
 class Predictor:
     """reference Predictor (paddle_inference_api.h): named-handle feed /
-    run / named-handle fetch over the loaded artifact."""
+    run / named-handle fetch over the loaded artifact.
 
-    def __init__(self, config: Config):
+    A predictor may alternatively be MODEL-BACKED (``create_predictor(
+    model=layer)``): instead of a fixed-shape exported artifact it holds a
+    live causal-LM Layer, and :meth:`generate` serves it through the
+    decode engine (static slotted KV cache + continuous batching —
+    SERVING.md)."""
+
+    def __init__(self, config: Config = None, model=None):
+        self._layer = model
+        if model is not None:
+            self._impl = None
+            self._inputs, self._outputs = {}, {}
+            return
+        if config is None:
+            raise ValueError("Predictor needs a Config (artifact-backed) "
+                             "or model= (serving-engine-backed)")
         if not os.path.exists(config._prefix + ".pdiparams"):
             raise FileNotFoundError(
                 "no artifact at prefix %r (expected .pdiparams/.pdmodel "
@@ -115,19 +129,30 @@ class Predictor:
         self._inputs = {n: Tensor(n) for n in self._impl.feed_names}
         self._outputs = {n: Tensor(n) for n in self._impl.fetch_names}
 
+    def _require_artifact(self, what):
+        if self._impl is None:
+            raise RuntimeError(
+                "%s needs an artifact-backed predictor; this one wraps a "
+                "live model — use generate(...)" % (what,))
+
     def get_input_names(self):
+        self._require_artifact("get_input_names()")
         return list(self._impl.feed_names)
 
     def get_output_names(self):
+        self._require_artifact("get_output_names()")
         return list(self._impl.fetch_names)
 
     def get_input_handle(self, name):
+        self._require_artifact("get_input_handle()")
         return self._inputs[name]
 
     def get_output_handle(self, name):
+        self._require_artifact("get_output_handle()")
         return self._outputs[name]
 
     def run(self):
+        self._require_artifact("run()")
         feeds = [self._inputs[n]._value for n in self._impl.feed_names]
         outs = self._impl.run(feeds)
         names = self._impl.fetch_names or [
@@ -136,9 +161,33 @@ class Predictor:
             self._outputs.setdefault(n, Tensor(n))._value = o.numpy()
         return True
 
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0,
+                 num_slots=None, max_len=None):
+        """Serve autoregressive generation through the decode engine
+        (static slotted KV cache + continuous batching; the decode step
+        compiles once for the life of the predictor — SERVING.md).
 
-def create_predictor(config: Config) -> Predictor:
-    return Predictor(config)
+        ``input_ids``: 2-D int array of prompts, or a ragged list of 1-D
+        prompts.  Returns a list of 1-D int32 np arrays (generated ids,
+        prompts excluded), in input order."""
+        if self._layer is None:
+            raise NotImplementedError(
+                "generate() needs a model-backed predictor "
+                "(create_predictor(model=layer)): the exported StableHLO "
+                "artifact is fixed-shape and cannot host the slotted "
+                "decode loop — re-create the predictor from the Layer, "
+                "or run the engine directly (paddle_tpu.serving.generate)")
+        from .serving import generate as _generate
+        return _generate(self._layer, input_ids,
+                         max_new_tokens=max_new_tokens,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed,
+                         num_slots=num_slots, max_len=max_len)
+
+
+def create_predictor(config: Config = None, model=None) -> Predictor:
+    return Predictor(config, model=model)
 
 
 def get_version():

@@ -463,7 +463,8 @@ def report() -> Dict[str, Dict[str, dict]]:
 
 def _import_kernel_families():
     """Family registration happens at kernel-module import."""
-    from . import ce_pallas, flash_attention_pallas, norm_pallas  # noqa: F401
+    from . import (ce_pallas, decode_attention,  # noqa: F401
+                   flash_attention_pallas, norm_pallas)
 
 
 def standard_keys() -> List[tuple]:
@@ -482,6 +483,11 @@ def standard_keys() -> List[tuple]:
     out.append(("ce_lse", cep.autotune_key(n=8192, v=50304, dtype=dtype)))
     from . import norm_pallas as nop
     out.append(("ln", nop.autotune_key(n=8192, f=1024, dtype=dtype)))
+    from . import decode_attention as dat
+    # the serving decode step's attention at the bench-standard serving
+    # shape (8 slots, 1024-token cache, GPT-2 345M heads)
+    out.append(("decode_attn", dat.autotune_key(
+        slots=8, t=1024, h=16, d=64, qlen=1, dtype=dtype)))
     return out
 
 

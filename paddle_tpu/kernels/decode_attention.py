@@ -1,0 +1,210 @@
+"""Decode attention — length-masked attention over the slotted KV cache.
+
+The serving decode step attends ``q: (slots, s, heads, d)`` (``s`` is 1
+for plain decode) against the full static cache ``k/v: (slots, max_len,
+heads, d)`` with each slot masked to its valid prefix: query offset ``j``
+of a slot with pre-append length ``n`` attends keys ``t <= n + j``.
+
+Registered as the ``decode_attn`` autotune family so the variant choice
+can be tuned on-chip next TPU session (PERF.md protocol).  Variants are
+XLA-level (no Pallas) — at decode shapes the op is bandwidth-bound on
+the K/V read, which XLA already streams well; what is worth tuning is
+the *schedule*:
+
+* ``masked`` (default) — one-shot: full ``(slots, h, s, max_len)``
+  masked logits, f32 softmax statistics.  Minimal launches; peak memory
+  O(slots*h*s*max_len) f32.
+* ``chunked`` — online-softmax streamed over ``block_t``-sized key
+  chunks (the flash recurrence along the time axis): O(block_t) logits
+  working set, and chunks wholly past every slot's valid prefix still
+  compute but contribute zeros.  Candidate win at long ``max_len`` where
+  the one-shot logits buffer stops fitting close to the compute.
+
+Both variants keep the bf16-region dtype discipline TPU501 audits:
+``dot_general`` runs on the input dtype with ``preferred_element_type``
+f32 accumulation, the softmax statistic chain stays f32, and ``p`` is
+cast back to the input dtype before the second matmul.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decode_attention", "autotune_key", "supported_block_ts"]
+
+_NEG_INF = -1e30
+
+
+def autotune_key(slots, t, h, d, qlen, dtype):
+    from . import autotune as at
+    return {"slots": int(slots), "t": int(t), "h": int(h), "d": int(d),
+            "qlen": int(qlen), "dtype": str(jnp.dtype(dtype)),
+            "platform": at.platform()}
+
+
+def _scale(scale, d):
+    if scale is None:
+        return jnp.asarray(1.0 / (float(d) ** 0.5), jnp.float32)
+    return jnp.asarray(scale, jnp.float32)
+
+
+def _masked(q, k, v, pos, scale):
+    """One-shot masked softmax attention (f32 statistics)."""
+    s, t = q.shape[1], k.shape[1]
+    # (B, s, H, D) x (B, T, H, D) -> (B, H, s, T), f32 accumulation
+    logits = jnp.einsum("bqhd,bthd->bhqt", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * _scale(scale, q.shape[-1])
+    t_ids = jnp.arange(t, dtype=jnp.int32)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    valid = t_ids[None, None, None, :] <= q_pos[:, None, :, None]
+    logits = jnp.where(valid, logits, jnp.asarray(_NEG_INF, jnp.float32))
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqt,bthd->bqhd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _chunked(q, k, v, pos, scale, block_t):
+    """Online-softmax over key chunks (flash recurrence along time)."""
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    n_chunks = t // block_t
+    sc = _scale(scale, d)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    kc = k.reshape(b, n_chunks, block_t, h, d)
+    vc = v.reshape(b, n_chunks, block_t, h, d)
+    # scan carries f32 statistics; chunks are the scanned axis
+    kc = jnp.moveaxis(kc, 1, 0)
+    vc = jnp.moveaxis(vc, 1, 0)
+
+    def body(carry, xs):
+        m, l, acc = carry
+        k_blk, v_blk, c = xs
+        logits = jnp.einsum("bqhd,bthd->bhqt", q, k_blk,
+                            preferred_element_type=jnp.float32) * sc
+        t_ids = c * block_t + jnp.arange(block_t, dtype=jnp.int32)
+        valid = t_ids[None, None, None, :] <= q_pos[:, None, :, None]
+        logits = jnp.where(valid, logits,
+                           jnp.asarray(_NEG_INF, jnp.float32))
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # m_new can stay -inf-ish for rows with no valid key yet; the
+        # exp of (NEG_INF - NEG_INF) = exp(0) rows are zeroed by `valid`
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(valid, p, jnp.zeros((), jnp.float32))
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqt,bthd->bhqd", p.astype(q.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr[..., None] + pv
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    a0 = jnp.zeros((b, h, s, d), jnp.float32)
+    chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), (kc, vc, chunk_ids))
+    out = acc / jnp.maximum(l, jnp.asarray(1e-30, jnp.float32))[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # (B,H,s,D)->(B,s,H,D)
+
+
+def supported_block_ts(t):
+    return [bt for bt in (128, 256, 512) if t % bt == 0 and bt < t]
+
+
+def _candidates(key):
+    out = [{"variant": "masked", "config": {}}]
+    for bt in supported_block_ts(key["t"]):
+        out.append({"variant": "chunked", "config": {"block_t": bt}})
+    return out
+
+
+def _dispatch(cand, q, k, v, pos, scale):
+    if cand.get("variant") == "chunked":
+        bt = int(cand.get("config", {}).get("block_t", 0))
+        if bt > 0 and k.shape[1] % bt == 0:
+            return _chunked(q, k, v, pos, scale, bt)
+        # invalid cached/pinned config for this key: fall back, never fault
+    return _masked(q, k, v, pos, scale)
+
+
+def decode_attention(q, k, v, lengths, scale=None):
+    """Length-masked attention for the slotted decode step (raw arrays).
+
+    q: (slots, s, heads, d); k/v: (slots, max_len, heads, d);
+    lengths: (slots,) int32 — each slot's PRE-append valid length (the new
+    rows were already written at [lengths, lengths+s), so query offset j
+    attends keys t <= lengths + j).
+    """
+    from . import autotune as at
+    key = autotune_key(q.shape[0], k.shape[1], q.shape[2], q.shape[3],
+                       q.shape[1], q.dtype)
+    cand = at.resolve("decode_attn", key)
+    return _dispatch(cand, q, k, v, lengths, scale)
+
+
+# ---------------------------------------------------------------------------
+# autotune runner / traceable
+# ---------------------------------------------------------------------------
+
+_RUNNER_OPERANDS = {}
+
+
+def _operands(key):
+    from ..core.dtype import x64_scope
+    ks = tuple(sorted(key.items()))
+    ops = _RUNNER_OPERANDS.get(ks)
+    if ops is None:
+        with x64_scope(False):
+            rng = jax.random.key(0)
+            kq, kk, kv = jax.random.split(rng, 3)
+            dt = jnp.dtype(key["dtype"])
+            b, t, h, d, s = (key["slots"], key["t"], key["h"], key["d"],
+                            key["qlen"])
+            q = jax.random.normal(kq, (b, s, h, d), dt)
+            k = jax.random.normal(kk, (b, t, h, d), dt)
+            v = jax.random.normal(kv, (b, t, h, d), dt)
+            # representative fill: slots at staggered depths
+            pos = (jnp.arange(b, dtype=jnp.int32) * (t // max(b, 1))
+                   % jnp.asarray(max(t - s, 1), jnp.int32))
+        ops = _RUNNER_OPERANDS[ks] = (q, k, v, pos)
+    return ops
+
+
+def _runner(cand, key):
+    from ..core.dtype import x64_scope
+    q, k, v, pos = _operands(key)
+    with x64_scope(False):
+        fn = jax.jit(functools.partial(_dispatch, cand, scale=None))
+        fn(q, k, v, pos).block_until_ready()  # compile outside the timer
+
+    def run():
+        jax.block_until_ready(fn(q, k, v, pos))
+    return run
+
+
+def _cleanup(key):
+    _RUNNER_OPERANDS.pop(tuple(sorted(key.items())), None)
+
+
+def _traceable(cand, key):
+    dt = jnp.dtype(key["dtype"])
+    b, t, h, d, s = (key["slots"], key["t"], key["h"], key["d"],
+                     key["qlen"])
+    q = jax.ShapeDtypeStruct((b, s, h, d), dt)
+    k = jax.ShapeDtypeStruct((b, t, h, d), dt)
+    v = jax.ShapeDtypeStruct((b, t, h, d), dt)
+    pos = jax.ShapeDtypeStruct((b,), jnp.int32)
+    return functools.partial(_dispatch, cand, scale=None), (q, k, v, pos)
+
+
+def _register():
+    from . import autotune as at
+    at.register_family("decode_attn", _candidates, _runner,
+                       cleanup=_cleanup, traceable=_traceable)
+
+
+_register()

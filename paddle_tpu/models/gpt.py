@@ -132,7 +132,15 @@ class GPTAttention(Layer):
                         [b, s, self.num_heads, self.head_dim])
         v = ops.reshape(qkv[:, :, 2 * h:],
                         [b, s, self.num_heads, self.head_dim])
+        if cache is not None and not isinstance(cache, (tuple, list)):
+            # static slotted cache (serving.cache view): append into the
+            # preallocated buffers + length-masked attention — one shape
+            # for the life of the process, no per-token retrace
+            out = cache.attend(q, k, v)
+            out = ops.reshape(out, [b, s, self.hidden_size])
+            return self.resid_dropout(self.out_proj(out)), cache
         if cache is not None:
+            # LEGACY CONCAT SHIM (see GPTForCausalLM.gen_legacy_concat_cache)
             pk, pv = cache
             k = ops.concat([pk, k], axis=1)
             v = ops.concat([pv, v], axis=1)
@@ -232,21 +240,32 @@ def _scan_block_apply(x, p, cfg, *, training, keys=None, cache=None):
     q = qkv[..., :h_sz].reshape(b, s, nh, hd)
     k = qkv[..., h_sz:2 * h_sz].reshape(b, s, nh, hd)
     v = qkv[..., 2 * h_sz:].reshape(b, s, nh, hd)
-    if cache is not None:
+    if cache is not None and not isinstance(cache, (tuple, list)):
+        # static slotted cache view (serving.cache): in-place append +
+        # length-masked attention — no shape growth, no retrace
+        out = cache.attend_raw(q, k, v)
+    elif cache is not None:
+        # LEGACY CONCAT SHIM (see GPTForCausalLM.gen_legacy_concat_cache)
         pk, pv = cache
         k = jnp.concatenate([pk, k], axis=1)
         v = jnp.concatenate([pv, v], axis=1)
         cache = (k, v)
-    attn_p = cfg.attention_dropout_prob
-    if attn_p > 0.0 and training and keys is not None:
-        # explicit per-layer key: sdpa's own next_key() would be a closure
-        # constant inside the scan body (same mask every layer)
-        out = sdpa_reference_raw(q, k, v, None, attn_p, True, None, keys[0])
-    else:
         out = scaled_dot_product_attention(q, k, v, is_causal=True,
                                            training=training)
         if isinstance(out, Tensor):
             out = out._array
+    else:
+        attn_p = cfg.attention_dropout_prob
+        if attn_p > 0.0 and training and keys is not None:
+            # explicit per-layer key: sdpa's own next_key() would be a
+            # closure constant inside the scan body (same mask every layer)
+            out = sdpa_reference_raw(q, k, v, None, attn_p, True, None,
+                                     keys[0])
+        else:
+            out = scaled_dot_product_attention(q, k, v, is_causal=True,
+                                               training=training)
+            if isinstance(out, Tensor):
+                out = out._array
     out = out.reshape(b, s, h_sz)
     out = out @ p["out_w"] + p["out_b"]
     out = dropout(out, cfg.hidden_dropout_prob,
@@ -326,8 +345,29 @@ class GPTScanBlocks(Layer):
             from ..core import random as _rnd
             flat = jax.random.split(_rnd.next_key(), c.num_hidden_layers * 3)
             keys = flat.reshape(c.num_hidden_layers, 3, *flat.shape[1:])
+        if cache is not None and not isinstance(cache, (tuple, list)):
+            # slotted decode path: the per-layer walk re-enters inside ONE
+            # traced fn, over a clone of the view whose arrays are that
+            # trace's own arguments (and outputs — no tracer leaks onto
+            # the caller's view object)
+            seq = int(x.shape[1]) if hasattr(x, "shape") else 1
+
+            def raw_decode_slotted(x, params, kc, vc, lengths):
+                inner = cache.clone_raw(kc, vc, lengths)
+                for i in range(c.num_hidden_layers):
+                    pi = {k: v[i] for k, v in params.items()}
+                    x, _ = _scan_block_apply(x, pi, c, training=False,
+                                             cache=inner)
+                return x, inner.k, inner.v
+
+            x_out, kc, vc = call(raw_decode_slotted, x, params,
+                                 cache.k, cache.v, cache.lengths,
+                                 name="gpt_scan_blocks")
+            cache.adopt(kc, vc, steps=seq)
+            return x_out, cache
         if cache is not None:
-            # decode path: python loop over leading-axis slices (no grads)
+            # LEGACY CONCAT SHIM decode path: python loop over leading-axis
+            # slices (no grads); shapes grow per token — retraces every step
             def raw_decode(x, params, *flat_cache):
                 cache_l = [(flat_cache[2 * i], flat_cache[2 * i + 1])
                            for i in range(c.num_hidden_layers)]
@@ -464,16 +504,37 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, cache=None):
         b, s = input_ids.shape
+        finalize = False
+        view = None
+        if cache is not None and not isinstance(cache, (tuple, list)):
+            from ..serving.cache import (DecodeView, SlottedKVCache,
+                                         is_cache_view)
+            if isinstance(cache, SlottedKVCache):
+                # bare cache state -> batched decode semantics; the caller
+                # gets the advanced SlottedKVCache back
+                cache = DecodeView(cache)
+                finalize = True
+            if not is_cache_view(cache):
+                raise TypeError(
+                    "cache must be a SlottedKVCache, a serving cache view, "
+                    "or the legacy per-layer (k, v) tuple list; got %r"
+                    % (type(cache).__name__,))
+            view = cache
         if position_ids is None:
-            start = 0 if cache is None else cache[0][0].shape[1]
-            position_ids = ops.arange(start, start + s, dtype="int32")
-            position_ids = ops.unsqueeze(position_ids, 0)
+            if view is not None:
+                position_ids = Tensor(view.position_ids(b, s))
+            else:
+                start = 0 if cache is None else cache[0][0].shape[1]
+                position_ids = ops.arange(start, start + s, dtype="int32")
+                position_ids = ops.unsqueeze(position_ids, 0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         x = with_sharding_constraint(x, PartitionSpec("dp", "sep", None))
         if self.config.scan_layers:
             if cache is not None:
                 x, new_caches = self.h_stack(x, cache)
+                if finalize:
+                    new_caches = view.finalize()
                 return self.ln_f(x), new_caches
             return self.ln_f(self.h_stack(x))
         new_caches = []
@@ -482,7 +543,9 @@ class GPTModel(Layer):
         else:
             _recompute = None
         for i, block in enumerate(self.h):
-            if cache is not None:
+            if view is not None:
+                x, _ = block(x, view)
+            elif cache is not None:
                 x, ci = block(x, cache[i])
                 new_caches.append(ci)
             elif _recompute is not None:
@@ -490,6 +553,8 @@ class GPTModel(Layer):
             else:
                 x = block(x)
         x = self.ln_f(x)
+        if view is not None:
+            return x, (view.finalize() if finalize else view)
         if cache is not None:
             return x, new_caches
         return x
@@ -520,12 +585,49 @@ class GPTForCausalLM(Layer):
             return logits, cache
         return logits
 
-    def gen_cache(self, batch_size, dtype="float32"):
+    def gen_cache(self, batch_size, dtype="float32", max_len=None):
+        """Preallocated static-shape slotted KV cache
+        (``serving.cache.SlottedKVCache``): one decode program shape for
+        the life of the process.  ``batch_size`` is the number of slots;
+        ``max_len`` defaults to the model's position budget."""
+        from ..serving.cache import SlottedKVCache
+        c = self.config
+        return SlottedKVCache.create(
+            batch_size, c.num_hidden_layers,
+            max_len or c.max_position_embeddings, c.num_attention_heads,
+            c.hidden_size // c.num_attention_heads, dtype)
+
+    def gen_legacy_concat_cache(self, batch_size, dtype="float32"):
+        """COMPAT SHIM — the pre-serving concat-grown cache: the K/V
+        arrays grow by one token per step, so the cache SHAPE changes
+        every call and any jit around the decode retraces and recompiles
+        per generated token.  Kept only for exported-artifact parity and
+        old callers; everything new uses :meth:`gen_cache` (static
+        slotted) or :meth:`generate`."""
         c = self.config
         empty = ops.zeros(
             [batch_size, 0, c.num_attention_heads,
              c.hidden_size // c.num_attention_heads], dtype)
         return [(empty, empty) for _ in range(c.num_hidden_layers)]
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0,
+                 num_slots=None, max_len=None, greedy=None):
+        """Generate continuations through the serving engine (static
+        slotted cache + continuous-batching decode — the decode step
+        compiles once, not once per token).
+
+        ``input_ids``: (batch, prompt_len) int array (or a list of 1-D
+        prompts of different lengths).  Returns a list of 1-D int32
+        numpy arrays of generated tokens (prompt excluded).
+        ``greedy=True`` is shorthand for temperature 0."""
+        from ..serving import generate as _generate
+        if greedy:
+            temperature = 0.0
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_token_id=eos_token_id, seed=seed,
+                         num_slots=num_slots, max_len=max_len)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -1,0 +1,124 @@
+"""paddle_tpu.serving — the TPU-native serving engine.
+
+Static-shape slotted KV cache (:mod:`.cache`), compile-once batched
+decode + bucketed prefill (:mod:`.engine`), Orca-style continuous
+batching (:mod:`.scheduler`), and per-slot greedy/temperature/top-k/
+top-p sampling with a threaded PRNG key (:mod:`.sampling`).
+See SERVING.md for the design and the on-chip A/B protocol.
+
+Import discipline: ``models/gpt.py`` imports :mod:`.cache`, so this
+``__init__`` must not eagerly import :mod:`.engine` (which imports the
+models back) — engine/scheduler resolve lazily via module ``__getattr__``.
+"""
+from __future__ import annotations
+
+from .cache import DecodeView, PrefillView, SlottedKVCache, is_cache_view
+from .sampling import TOP_K_MAX, sample
+
+__all__ = [
+    "SlottedKVCache", "DecodeView", "PrefillView", "is_cache_view",
+    "sample", "TOP_K_MAX", "DecodeEngine", "ContinuousBatchingScheduler",
+    "Request", "RequestResult", "generate", "engine_for",
+]
+
+_LAZY = {
+    "DecodeEngine": ("paddle_tpu.serving.engine", "DecodeEngine"),
+    "ContinuousBatchingScheduler": ("paddle_tpu.serving.scheduler",
+                                    "ContinuousBatchingScheduler"),
+    "Request": ("paddle_tpu.serving.scheduler", "Request"),
+    "RequestResult": ("paddle_tpu.serving.scheduler", "RequestResult"),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    import importlib
+    return getattr(importlib.import_module(entry[0]), entry[1])
+
+
+#: bound on cached engines per model: each holds two full preallocated
+#: (slots, layers, max_len, heads, head_dim) KV buffers, so an unbounded
+#: cache would pin hundreds of MB per distinct geometry at serving shapes
+_MAX_CACHED_ENGINES = 4
+
+
+def engine_for(model, num_slots=4, max_len=None, **kw):
+    """A per-model engine cache: repeated :func:`generate` calls with the
+    same geometry reuse the compiled decode program (the compile-once
+    contract spans calls).  The engine re-snapshots the model parameters
+    on every use, so training between calls is reflected.  At most
+    :data:`_MAX_CACHED_ENGINES` geometries are kept (LRU) — geometry is
+    also bucketed by :func:`generate` so the default path reuses one.
+    The RNG seed is NOT part of the geometry (it is a host-side base key
+    — callers reseed the cached engine instead of building another)."""
+    from .engine import DecodeEngine
+    key = (int(num_slots), max_len if max_len is None else int(max_len),
+           tuple(sorted(kw.items())))
+    cache = model.__dict__.get("_serving_engines")
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, "_serving_engines", cache)
+    eng = cache.pop(key, None)           # re-insert = move to LRU tail
+    if eng is None:
+        eng = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
+                           **kw)
+        while len(cache) >= _MAX_CACHED_ENGINES:
+            cache.pop(next(iter(cache)))
+    else:
+        eng.refresh_state()
+    cache[key] = eng
+    return eng
+
+
+def generate(model, prompts, max_new_tokens=20, temperature=1.0, top_k=0,
+             top_p=1.0, eos_token_id=None, seed=0, num_slots=None,
+             max_len=None, **engine_kw):
+    """Generate continuations for ``prompts`` through the engine +
+    continuous-batching scheduler.  ``prompts``: a 2-D int array (each
+    row one prompt), ONE 1-D prompt (a flat list of ints is one prompt,
+    not N single-token prompts), or a list of 1-D prompts of ragged
+    lengths.  Returns a list of 1-D int32 np arrays of generated ids,
+    in submission order (a one-element list for 1-D input too).
+    """
+    import numpy as np
+
+    from .scheduler import ContinuousBatchingScheduler, Request
+
+    arr = prompts._array if hasattr(prompts, "_array") else prompts
+    try:
+        arr = np.asarray(arr)
+    except ValueError:                    # ragged list of prompts
+        arr = None
+    if arr is not None and arr.dtype != object:
+        if arr.ndim == 1:                 # one prompt, not N scalar ones
+            arr = arr.reshape(1, -1)
+        if arr.ndim != 2:
+            raise ValueError("prompts must be 1-D, 2-D, or a list of 1-D "
+                             "prompts; got shape %r" % (arr.shape,))
+        prompt_list = [arr[i] for i in range(arr.shape[0])]
+    else:
+        prompt_list = [np.asarray(
+            p._array if hasattr(p, "_array") else p).reshape(-1)
+            for p in prompts]
+    if num_slots is None:
+        # bucket to a power of two (1/2/4/8): the engine geometry stays
+        # stable across calls with nearby batch sizes, so the compiled
+        # decode program (and its cache buffers) are reused, not rebuilt
+        num_slots = 1
+        while num_slots < min(len(prompt_list), 8):
+            num_slots *= 2
+    eng = engine_for(model, num_slots=num_slots, max_len=max_len,
+                     **engine_kw)
+    # restart the threaded key stream: generate(seed=s) is reproducible
+    # whether the engine was cached or freshly built
+    eng.reseed(seed)
+    sched = ContinuousBatchingScheduler(eng)
+    rids = [sched.submit(Request(
+        prompt=p, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, eos_token_id=eos_token_id))
+        for p in prompt_list]
+    results = sched.run()
+    return [results[r].tokens for r in rids]

@@ -1,0 +1,250 @@
+"""Static-shape slotted KV cache — the serving engine's memory layout.
+
+The decode path's non-negotiable TPU precondition is a *static-shape*
+program: the legacy cache grew by ``concat`` each token, so its shape
+changed every step and **every generated token retraced and recompiled
+the whole model**.  Here the cache is preallocated once as
+
+    k, v : (num_slots, layers, max_len, heads, head_dim)
+    lengths : (num_slots,) int32           # valid prefix per slot
+
+and every append is an in-place-aliasable write (scatter at per-slot
+positions for batched decode, ``lax.dynamic_update_slice`` for
+single-slot prefill) into the *donated* buffers — the jitted decode step
+has ONE shape for the life of the process (Orca's iteration-level
+batching precondition; vLLM's PagedAttention solves the same problem
+with block tables, which static XLA shapes make unnecessary at these
+slot counts: a slot IS a page of ``max_len`` tokens).
+
+Attention over the cache is masked to each slot's valid prefix: the
+query token at block offset ``j`` of a slot with pre-append length ``n``
+sits at global position ``n + j`` and may attend keys ``t <= n + j``.
+That one formula covers batched decode (``j = 0``), multi-token
+speculative steps, and whole-prompt prefill (``n = 0`` reduces it to the
+causal mask).
+
+Two *views* adapt the cache to the model's per-layer walk (they are
+trace-time carriers, not pytrees — the arrays they hold thread through
+``jit`` as ordinary tracers):
+
+* :class:`DecodeView` — batched: batch dim == num_slots, every active
+  slot advances together in one fixed-shape program.
+* :class:`PrefillView` — one sequence, one (dynamic) slot index, writes
+  rows ``[0, bucket)`` and runs plain block-causal attention (nothing
+  prior to attend to).
+
+Dependency note: this module is imported by ``models/gpt.py`` and must
+stay model-free (jax + the decode-attention kernel family only).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SlottedKVCache", "DecodeView", "PrefillView", "is_cache_view"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SlottedKVCache:
+    """The preallocated cache state.  A registered pytree, so it passes
+    through ``jax.jit`` boundaries (and ``donate_argnums``) directly."""
+
+    def __init__(self, k, v, lengths):
+        self.k = k
+        self.v = v
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, num_slots, num_layers, max_len, num_heads, head_dim,
+               dtype="float32"):
+        shape = (int(num_slots), int(num_layers), int(max_len),
+                 int(num_heads), int(head_dim))
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((int(num_slots),), jnp.int32))
+
+    # -- static geometry (python ints — safe at trace time) ----------------
+    @property
+    def num_slots(self):
+        return int(self.k.shape[0])
+
+    @property
+    def num_layers(self):
+        return int(self.k.shape[1])
+
+    @property
+    def max_len(self):
+        return int(self.k.shape[2])
+
+    def __repr__(self):
+        return ("SlottedKVCache(slots=%d, layers=%d, max_len=%d, heads=%d, "
+                "head_dim=%d, dtype=%s)"
+                % (self.k.shape + (self.k.dtype,)))
+
+
+def is_cache_view(obj) -> bool:
+    return isinstance(obj, _CacheView)
+
+
+def _unwrap(x):
+    return x._array if hasattr(x, "_array") else x
+
+
+class _CacheView:
+    """Trace-time carrier threading the cache arrays through the model's
+    per-layer walk.  Layers call :meth:`attend` (Tensor-level, tape-aware)
+    or :meth:`attend_raw` (raw arrays, for the scan-layers block body) in
+    order; the view allocates layer indices from an internal cursor."""
+
+    def __init__(self, cache: SlottedKVCache):
+        self.k = _unwrap(cache.k)
+        self.v = _unwrap(cache.v)
+        self.lengths = _unwrap(cache.lengths)
+        self._layer = 0
+
+    def _alloc_layer(self) -> int:
+        i = self._layer
+        if i >= int(self.k.shape[1]):
+            raise ValueError(
+                "cache view exhausted: model has more attention layers "
+                "than the cache's layer axis (%d)" % (self.k.shape[1],))
+        self._layer = i + 1
+        return i
+
+    def attend(self, q, k_new, v_new, scale=None):
+        """Tensor-level append+attend (dispatches through core.dispatch.call
+        so eager autograd bookkeeping stays consistent)."""
+        from ..core.dispatch import call
+        layer = self._alloc_layer()
+
+        def raw(kc, vc, lengths, q_, k_, v_):
+            out, kc2, vc2 = self._append_attend_raw(
+                layer, kc, vc, lengths, q_, k_, v_, scale)
+            return out, kc2, vc2
+
+        out, kc, vc = call(raw, self.k, self.v, self.lengths,
+                           q, k_new, v_new, name="slotted_kv_attend")
+        self.k, self.v = _unwrap(kc), _unwrap(vc)
+        return out
+
+    def attend_raw(self, q, k_new, v_new, scale=None):
+        """Raw-array append+attend (the scan-layers block body path)."""
+        layer = self._alloc_layer()
+        out, self.k, self.v = self._append_attend_raw(
+            layer, self.k, self.v, self.lengths, q, k_new, v_new, scale)
+        return out
+
+    def clone_raw(self, k, v, lengths):
+        """A fresh same-typed view over explicit raw arrays — for code that
+        re-enters the per-layer walk inside its own traced function (the
+        scan-layers decode path): the clone's arrays are that trace's
+        arguments, so no tracer ever leaks onto this view."""
+        import copy
+        c = copy.copy(self)
+        c.k, c.v = _unwrap(k), _unwrap(v)
+        c.lengths = _unwrap(lengths)
+        c._layer = 0
+        return c
+
+    def adopt(self, k, v, steps=None):
+        """Take the (concrete) arrays a traced clone produced as outputs."""
+        self.k, self.v = _unwrap(k), _unwrap(v)
+        self._layer = int(self.k.shape[1])
+        if steps is not None and hasattr(self, "_steps"):
+            self._steps = int(steps)
+
+
+class DecodeView(_CacheView):
+    """Batched decode: q/k/v arrive as (num_slots, s, heads, head_dim);
+    each slot's ``s`` new tokens are written at rows
+    ``[lengths[b], lengths[b] + s)`` and attention is masked to
+    ``t <= lengths[b] + j``.  ``active`` gates which slots advance their
+    length counter at :meth:`finalize` (inactive slots still compute —
+    the program shape never changes — but their writes land past their
+    frozen valid prefix and are overwritten on slot reuse)."""
+
+    def __init__(self, cache: SlottedKVCache, active=None):
+        super().__init__(cache)
+        self.active = None if active is None else _unwrap(active)
+        self._steps = 0
+
+    def position_ids(self, batch, seq_len):
+        if batch != int(self.k.shape[0]):
+            raise ValueError(
+                "batched decode needs batch == num_slots (%d), got %d — "
+                "use PrefillView for single sequences"
+                % (self.k.shape[0], batch))
+        return (self.lengths[:, None]
+                + jnp.arange(seq_len, dtype=jnp.int32)[None, :])
+
+    def _append_attend_raw(self, layer, kc, vc, lengths, q, k_new, v_new,
+                           scale):
+        from ..kernels.decode_attention import decode_attention
+        s = int(q.shape[1])
+        self._steps = s
+        b_idx = jnp.arange(kc.shape[0], dtype=jnp.int32)[:, None]
+        t_idx = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        # one scatter into the (donated) full cache buffer per array; XLA
+        # updates in place (the operand chains through each layer's write).
+        # Rows past max_len (a slot the scheduler failed to evict) drop.
+        kc = kc.at[b_idx, layer, t_idx].set(k_new.astype(kc.dtype))
+        vc = vc.at[b_idx, layer, t_idx].set(v_new.astype(vc.dtype))
+        out = decode_attention(q, kc[:, layer], vc[:, layer], lengths,
+                               scale=scale)
+        return out, kc, vc
+
+    def finalize(self) -> SlottedKVCache:
+        adv = jnp.asarray(self._steps, jnp.int32)
+        if self.active is not None:
+            adv = adv * self.active.astype(jnp.int32)
+        return SlottedKVCache(self.k, self.v, self.lengths + adv)
+
+
+class PrefillView(_CacheView):
+    """Bucketed single-sequence prefill into one slot: input is
+    ``(1, bucket)`` right-padded tokens with ``true_len`` real ones.
+    Writes rows ``[0, bucket)`` of the (dynamic) ``slot`` via
+    ``dynamic_update_slice`` and attends block-causally — pad rows
+    compute garbage that is masked forever (``lengths[slot] = true_len``)
+    and progressively overwritten by subsequent decode appends."""
+
+    def __init__(self, cache: SlottedKVCache, slot, true_len):
+        super().__init__(cache)
+        self.slot = jnp.asarray(_unwrap(slot), jnp.int32)
+        self.true_len = jnp.asarray(_unwrap(true_len), jnp.int32)
+
+    def position_ids(self, batch, seq_len):
+        if batch != 1:
+            raise ValueError("PrefillView is single-sequence (got batch=%d)"
+                             % batch)
+        return jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+
+    def _append_attend_raw(self, layer, kc, vc, lengths, q, k_new, v_new,
+                           scale):
+        from ..kernels import flash_attention as fa
+        from ..nn.functional.attention import sdpa_reference_raw
+        zero = jnp.zeros((), jnp.int32)
+        start = (self.slot, jnp.asarray(layer, jnp.int32), zero, zero, zero)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k_new.astype(kc.dtype)[:, None], start)
+        vc = jax.lax.dynamic_update_slice(
+            vc, v_new.astype(vc.dtype)[:, None], start)
+        # fresh slot: nothing precedes the block — attention is plain
+        # causal over the bucket (bucket^2 logits, not bucket*max_len),
+        # through the Pallas flash kernel when the shapes support it
+        if fa.supported(q, k_new):
+            out = fa.flash_attention_bshd(q, k_new, v_new, causal=True,
+                                          scale=scale)
+        else:
+            out = sdpa_reference_raw(q, k_new, v_new, None, 0.0, True, scale)
+        return out, kc, vc
+
+    def finalize(self) -> SlottedKVCache:
+        return SlottedKVCache(
+            self.k, self.v, self.lengths.at[self.slot].set(self.true_len))

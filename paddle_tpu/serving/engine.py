@@ -1,0 +1,271 @@
+"""The TPU-native decode engine: static-shape slotted KV cache + a
+batched decode step that compiles exactly once.
+
+Two compiled entry points over the :class:`~.cache.SlottedKVCache`:
+
+* ``prefill`` — one sequence, right-padded to a power-of-two *bucket*
+  (bounding the jit cache to ``log2(max_len)`` programs), written into
+  one (dynamic) slot; samples the first token from the last real
+  position's logits.
+* ``decode`` — ALL slots advance one token in one fixed-shape program:
+  append at per-slot lengths, length-masked attention
+  (``kernels.decode_attention`` — autotune family ``decode_attn``),
+  per-slot temperature/top-k/top-p sampling with a threaded PRNG key.
+  Every argument that varies across steps (tokens, active mask, sampling
+  parameters, key) is a traced array — nothing retraces, ever; asserted
+  by ``decode_compile_count``.
+
+Both entries **donate the cache buffers** (k, v, lengths): XLA aliases
+them input→output, so the multi-hundred-MB cache is updated in place
+instead of double-buffered (TPU502 audits that the aliasing actually
+materializes — see ``analysis/trace/programs.py``'s ``serving`` builder).
+
+The engine is deliberately request-free: slot admission/eviction policy
+lives in :mod:`.scheduler`.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import x64_scope
+from ..core.tensor import Tensor
+from .cache import DecodeView, PrefillView, SlottedKVCache
+from .sampling import TOP_K_MAX, sample
+
+__all__ = ["DecodeEngine", "prefill_buckets_for"]
+
+
+def prefill_buckets_for(max_len, min_bucket=16):
+    """Power-of-two prefill buckets up to ``max_len``; a non-power-of-two
+    ``max_len`` is appended as the final bucket so every prompt that fits
+    the cache has a bucket."""
+    out = []
+    b = min(int(min_bucket), int(max_len))
+    while b <= int(max_len):
+        out.append(b)
+        b *= 2
+    if not out or out[-1] < int(max_len):
+        out.append(int(max_len))
+    return out
+
+
+@contextlib.contextmanager
+def _eval_scope(model):
+    """Run the engine's compiled entries with the model in eval mode but
+    RESTORE the caller's mode after: generate() between training epochs
+    must not silently disable dropout for the rest of the run (mode only
+    matters at trace time, but the flip would otherwise leak out)."""
+    was_training = bool(getattr(model, "training", False))
+    model.eval()
+    try:
+        yield
+    finally:
+        if was_training:
+            model.train()
+
+
+class DecodeEngine:
+    """Compiled serving engine for a causal-LM Layer (``model(input_ids,
+    cache=<view>) -> (logits, cache)`` with a ``config`` carrying the
+    GPT geometry — :class:`paddle_tpu.models.gpt.GPTForCausalLM`)."""
+
+    def __init__(self, model, num_slots=4, max_len=None, cache_dtype=None,
+                 min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True):
+        cfg = model.config
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len or cfg.max_position_embeddings)
+        if self.max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                "max_len %d exceeds the model's position budget %d"
+                % (self.max_len, cfg.max_position_embeddings))
+        self.top_k_max = int(top_k_max)
+        self.buckets = prefill_buckets_for(self.max_len, min_bucket)
+        self.state = model.functional_state()
+        if cache_dtype is None:
+            # match the activation dtype: the embedding weight's dtype is
+            # what the residual stream (and so K/V) runs in
+            probe = getattr(getattr(model, "gpt", model), "wte", None)
+            cache_dtype = (jnp.dtype(probe.weight._array.dtype)
+                           if probe is not None
+                           else jnp.dtype(next(iter(self.state.values()
+                                                    )).dtype))
+        self.cache = SlottedKVCache.create(
+            self.num_slots, cfg.num_hidden_layers, self.max_len,
+            cfg.num_attention_heads,
+            cfg.hidden_size // cfg.num_attention_heads, cache_dtype)
+        self._base_key = jax.random.key(int(seed))
+        self._rng_step = 0
+
+        k_max = self.top_k_max
+
+        def decode_fn(state, cache_k, cache_v, lengths, tokens, active,
+                      key, temps, top_ks, top_ps):
+            """One batched decode iteration over every slot."""
+            model.eval()   # trace-time: cached decode is inference-only
+            view = DecodeView(SlottedKVCache(cache_k, cache_v, lengths),
+                              active=active)
+            from ..jit import functional_call
+            (logits, _), _ = functional_call(model, state, Tensor(tokens),
+                                             cache=view)
+            logits = logits[:, -1, :]
+            next_tok = sample(logits, key, temps, top_ks, top_ps, k_max)
+            out = view.finalize()
+            return next_tok, logits, out.k, out.v, out.lengths
+
+        def prefill_fn(state, tokens, slot, true_len, cache_k, cache_v,
+                       lengths, key, temp, top_k, top_p):
+            """Prefill one bucketed sequence into ``slot`` and sample the
+            first generated token from the last REAL position."""
+            model.eval()
+            view = PrefillView(SlottedKVCache(cache_k, cache_v, lengths),
+                               slot, true_len)
+            from ..jit import functional_call
+            (logits, _), _ = functional_call(model, state, Tensor(tokens),
+                                             cache=view)
+            last = jax.lax.dynamic_slice(
+                logits, (jnp.zeros((), jnp.int32),
+                         true_len - jnp.ones((), jnp.int32),
+                         jnp.zeros((), jnp.int32)),
+                (1, 1, logits.shape[-1]))[:, 0, :]
+            tok = sample(last, key, temp[None], top_k[None], top_p[None],
+                         k_max)[0]
+            out = view.finalize()
+            return tok, last[0], out.k, out.v, out.lengths
+
+        # hooks for the trace-tier audit (TPU501-505): the registry lowers
+        # the un-jitted fns with keep_unused=True at these donate_argnums
+        self._decode_fn = decode_fn
+        self._decode_donate_argnums = (1, 2, 3) if donate else ()
+        self._prefill_fn = prefill_fn
+        self._prefill_donate_argnums = (4, 5, 6) if donate else ()
+        self._decode = jax.jit(decode_fn,
+                               donate_argnums=self._decode_donate_argnums)
+        self._prefill = jax.jit(prefill_fn,
+                                donate_argnums=self._prefill_donate_argnums)
+
+    # -- host-side API -----------------------------------------------------
+
+    def refresh_state(self, state=None):
+        """Re-snapshot the model's parameters (same shapes/dtypes — no
+        recompile).  Call after training between generate rounds."""
+        self.state = state if state is not None else \
+            self.model.functional_state()
+
+    def reset(self):
+        """Zero the cache lengths (slot contents are overwritten lazily)."""
+        self.cache = SlottedKVCache(
+            self.cache.k, self.cache.v,
+            jnp.zeros((self.num_slots,), jnp.int32))
+
+    def reseed(self, seed):
+        """Restart the threaded key stream: after ``reseed(s)`` the next
+        prefill/decode sequence reproduces a fresh engine built with
+        ``seed=s`` (generate() calls this so its ``seed=`` argument means
+        the same thing on a cached engine as on a new one)."""
+        self._base_key = jax.random.key(int(seed))
+        self._rng_step = 0
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            "prompt length %d exceeds the largest prefill bucket %d "
+            "(max_len=%d)" % (n, self.buckets[-1], self.max_len))
+
+    def _next_key(self):
+        self._rng_step += 1
+        return jax.random.fold_in(self._base_key, self._rng_step)
+
+    def prefill(self, slot, token_ids, temperature=1.0, top_k=0,
+                top_p=1.0):
+        """Admit ``token_ids`` (1-D) into ``slot``; returns the sampled
+        first token (int) and the last-position logits (a jax array,
+        (vocab,) — left on device; np.asarray() it if needed host-side)."""
+        ids = np.asarray(token_ids, np.int32).reshape(-1)
+        n = int(ids.size)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.max_len:
+            raise ValueError("prompt length %d > max_len %d"
+                             % (n, self.max_len))
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = ids
+        # x64_scope(False) covers the (first-call) TRACE: the serving
+        # programs carry no s64/f64 — jax.random's counters and gather
+        # index widening follow the global x64 default otherwise (same
+        # discipline as the Pallas kernel entries; asserted over the
+        # compiled HLO by tests/test_serving.py)
+        with x64_scope(False), _eval_scope(self.model):
+            tok, logits, k, v, lengths = self._prefill(
+                self.state, jnp.asarray(padded),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(n, jnp.int32), self.cache.k, self.cache.v,
+                self.cache.lengths, self._next_key(),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(min(int(top_k), self.top_k_max), jnp.int32),
+                jnp.asarray(top_p, jnp.float32))
+        self.cache = SlottedKVCache(k, v, lengths)
+        return int(tok), logits
+
+    def decode(self, tokens, active, temperature, top_k, top_p):
+        """One batched decode step.  All inputs are per-slot host arrays
+        of length ``num_slots``; returns (next_tokens as an np array,
+        logits as a jax device array) — callers ignore entries of
+        inactive slots."""
+        toks = np.asarray(tokens, np.int32).reshape(self.num_slots, 1)
+        # x64/eval scopes: see prefill() — keep the traced program
+        # s64/f64-free and the caller's train/eval mode untouched
+        with x64_scope(False), _eval_scope(self.model):
+            tok, logits, k, v, lengths = self._decode(
+                self.state, self.cache.k, self.cache.v, self.cache.lengths,
+                jnp.asarray(toks), jnp.asarray(np.asarray(active, bool)),
+                self._next_key(),
+                jnp.asarray(np.asarray(temperature, np.float32)),
+                jnp.asarray(np.minimum(np.asarray(top_k, np.int32),
+                                       self.top_k_max)),
+                jnp.asarray(np.asarray(top_p, np.float32)))
+        self.cache = SlottedKVCache(k, v, lengths)
+        return np.asarray(tok), logits
+
+    def slot_lengths(self):
+        return np.asarray(self.cache.lengths)
+
+    # -- compile accounting (the "compiles exactly once" contract) ---------
+
+    @property
+    def decode_compile_count(self):
+        """Number of programs the decode jit holds — MUST stay 1."""
+        return int(self._decode._cache_size())
+
+    @property
+    def prefill_compile_count(self):
+        """<= len(self.buckets) by construction."""
+        return int(self._prefill._cache_size())
+
+    # -- audit hooks (analysis/trace/programs.py `serving` builder) --------
+
+    def decode_trace_args(self):
+        """The exact argument avals ``self._decode`` runs with (fixed key,
+        not drawn from the engine stream — lowering an audit must not
+        shift the live engine's sampling sequence)."""
+        s = self.num_slots
+        return (self.state, self.cache.k, self.cache.v, self.cache.lengths,
+                jnp.zeros((s, 1), jnp.int32), jnp.ones((s,), bool),
+                jax.random.key(0), jnp.ones((s,), jnp.float32),
+                jnp.zeros((s,), jnp.int32), jnp.ones((s,), jnp.float32))
+
+    def prefill_trace_args(self, bucket=None):
+        b = int(bucket or self.buckets[0])
+        return (self.state, jnp.zeros((1, b), jnp.int32),
+                jnp.zeros((), jnp.int32), jnp.asarray(b, jnp.int32),
+                self.cache.k, self.cache.v, self.cache.lengths,
+                jax.random.key(0), jnp.ones((), jnp.float32),
+                jnp.zeros((), jnp.int32), jnp.ones((), jnp.float32))

@@ -1,0 +1,114 @@
+"""Sampling for the batched decode step — greedy / temperature / top-k /
+top-p, vectorized over slots, with PER-SLOT parameters as traced arrays
+so the jitted decode step never specializes on them.
+
+Design constraints (each one is a regression test in
+``tests/test_serving.py``):
+
+* **Threaded PRNG key** — the key is an explicit argument threaded by the
+  engine (``fold_in(base, step)``), never drawn from the global eager
+  generator: sampling inside a compiled step must not shift the global
+  RNG stream of the surrounding program (the same discipline as
+  ``TrainStep.trace_args``).
+* **int32-safe under the x64 audit** — paddle parity enables
+  ``jax_enable_x64`` globally, so any dtype-less index math lands s64
+  (flagged as s64 *compute* by the runtime HLO audit).  Token ids come
+  from ``lax.top_k`` (int32 by construction — including the Gumbel-trick
+  categorical, which avoids ``argmax``'s s64 result) and every index
+  array is created int32.
+* **top-p keeps ≥ 1 token** — the cutoff is on the *exclusive* cumulative
+  mass (`mass before this token < p`), so the most-probable token always
+  survives, even for ``p == 0``.
+* **dynamic top-k without retracing** — ``lax.top_k`` needs a static k,
+  so the kernel takes the top ``TOP_K_MAX`` once and thresholds per-slot
+  at the (dynamic) k-th value; per-slot ``top_k`` stays a traced int32
+  array and the decode program compiles once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample", "apply_temperature", "apply_top_k", "apply_top_p",
+           "TOP_K_MAX"]
+
+#: static cap for per-slot top-k (requests are clamped host-side); the
+#: top-TOP_K_MAX values are computed once and thresholded dynamically
+TOP_K_MAX = 64
+
+_NEG = -1e30
+
+
+def apply_temperature(logits, temperature):
+    """logits: (slots, vocab) — divide by per-slot temperature.  Zero (or
+    negative) temperature means greedy; the division here just needs to be
+    finite, :func:`sample` picks the argmax branch for those slots."""
+    t = jnp.maximum(temperature.astype(jnp.float32), 1e-6)
+    return logits.astype(jnp.float32) / t[:, None]
+
+
+def apply_top_k(logits, top_k, k_max=TOP_K_MAX):
+    """Per-slot dynamic top-k: keep logits >= the k-th largest value;
+    ``top_k <= 0`` disables filtering for that slot."""
+    k_max = min(int(k_max), int(logits.shape[-1]))
+    vals, _ = jax.lax.top_k(logits, k_max)   # idx unused; vals sorted desc
+    kth_idx = jnp.clip(top_k.astype(jnp.int32) - 1, 0, k_max - 1)
+    # promise_in_bounds (the clip above guarantees it): under global x64
+    # the default gather path widens indices to s64 — the same fix as the
+    # cross-entropy gather (tests/test_x64_audit.py discipline)
+    kth = jnp.take_along_axis(vals, kth_idx[:, None], axis=-1,
+                              mode="promise_in_bounds")
+    keep = (logits >= kth) | (top_k <= 0)[:, None]
+    return jnp.where(keep, logits, jnp.asarray(_NEG, logits.dtype))
+
+
+def apply_top_p(logits, top_p):
+    """Per-slot nucleus filtering on the softmax of ``logits``.  A token
+    is kept while the probability mass STRICTLY BEFORE it (in descending
+    order) is < p — so the most-probable token is always kept (`mass
+    before it` is 0), the "keep at least one" guarantee.  ``top_p >= 1``
+    disables filtering for that slot.  Ties at the threshold probability
+    are all kept (the filter thresholds on probability values)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    sorted_p = jnp.sort(probs, axis=-1, descending=True)
+    mass_before = jnp.cumsum(sorted_p, axis=-1) - sorted_p   # exclusive
+    keep_sorted = mass_before < top_p.astype(jnp.float32)[:, None]
+    # smallest kept probability = the per-slot threshold; the first
+    # column of keep_sorted is mass_before==0 < p only when p > 0, so
+    # force-keep column 0 (p == 0.0 must still emit the top token)
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_p,
+                               jnp.asarray(jnp.inf, jnp.float32)), axis=-1)
+    keep = (probs >= thresh[:, None]) | (top_p >= 1.0)[:, None]
+    return jnp.where(keep, logits, jnp.asarray(_NEG, logits.dtype))
+
+
+def _int32_argmax(logits):
+    """argmax via top_k: int32 result regardless of jax_enable_x64 (a
+    bare ``jnp.argmax`` returns s64 under x64 and the cast back would
+    itself be s64 compute under the HLO audit)."""
+    _, idx = jax.lax.top_k(logits, 1)
+    return idx[..., 0]
+
+
+def sample(logits, key, temperature, top_k, top_p, k_max=TOP_K_MAX):
+    """One sampled (or greedy) token per slot.
+
+    logits: (slots, vocab); key: a single threaded PRNG key for this
+    step; temperature/top_p: (slots,) float; top_k: (slots,) int32
+    (<= 0 disables).  Returns (slots,) int32 token ids.
+    """
+    greedy_tok = _int32_argmax(logits)
+    scaled = apply_temperature(logits, temperature)
+    filtered = apply_top_p(apply_top_k(scaled, top_k, k_max), top_p)
+    # Gumbel-max categorical: argmax(logits + G) ~ softmax(logits); the
+    # top_k(…, 1) index is int32 by construction.  NOTE jax.random's
+    # threefry loop counters follow the global x64 default — the engine
+    # traces its whole entry under x64_scope(False) (the Pallas kernels'
+    # discipline; a scope around just this draw would be a mid-trace x64
+    # flip, which miscompiles — PERF.md/PR-1 history) so the compiled
+    # decode program carries no s64 at all.
+    g = jax.random.gumbel(key, filtered.shape, jnp.float32)
+    sampled_tok = _int32_argmax(filtered + g)
+    greedy = (temperature <= 0.0)
+    return jnp.where(greedy, greedy_tok, sampled_tok).astype(jnp.int32)

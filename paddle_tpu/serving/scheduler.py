@@ -1,0 +1,192 @@
+"""Continuous batching — Orca-style iteration-level scheduling on the
+host side of the compiled decode step.
+
+The unit of scheduling is ONE decode iteration, not one request: after
+every batched step the scheduler retires finished slots (EOS /
+``max_new_tokens`` / cache-full) and immediately admits waiting requests
+into the freed slots via bucketed prefill — the batch composition
+changes between iterations while the decode program (fixed shape: all
+``num_slots`` lanes every step) never recompiles.
+
+States of a slot: ``free`` → (admit: prefill, samples the first token)
+→ ``active`` → (EOS | budget | ``max_len``) → ``free``.  Admission is
+strict FIFO over the waiting queue; prefill lengths are bucketed to
+powers of two (``engine.buckets``) so the prefill jit cache is bounded
+by ``log2(max_len)`` programs.
+
+Per-request timing is recorded for the serving metrics the bench emits:
+TTFT (submit → first token, includes queue wait) and TPOT (mean decode
+seconds per subsequent token).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: "np.ndarray"                 # 1-D int token ids
+    max_new_tokens: int = 20
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    rid: Optional[int] = None            # assigned by submit()
+
+
+@dataclasses.dataclass
+class RequestResult:
+    rid: int
+    tokens: "np.ndarray"                 # generated ids (prompt excluded)
+    finish_reason: str                   # "eos" | "length" | "cache_full"
+    ttft: float                          # submit -> first token, seconds
+    tpot: float                          # mean secs/token after the first
+
+
+class _ActiveSlot:
+    __slots__ = ("req", "generated", "submit_t", "first_tok_t", "last_t",
+                 "decode_s")
+
+    def __init__(self, req, first_token, submit_t, now):
+        self.req = req
+        self.generated = [int(first_token)]
+        self.submit_t = submit_t
+        self.first_tok_t = now
+        self.last_t = now
+        self.decode_s = 0.0
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine):
+        self.engine = engine
+        self.waiting: deque = deque()
+        self.slots: List[Optional[_ActiveSlot]] = [None] * engine.num_slots
+        self.finished: Dict[int, RequestResult] = {}
+        self._next_rid = 0
+        self._submit_t: Dict[int, float] = {}
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, req: Request) -> int:
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size > self.engine.buckets[-1]:
+            raise ValueError(
+                "prompt length %d exceeds the largest prefill bucket %d"
+                % (prompt.size, self.engine.buckets[-1]))
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = dataclasses.replace(req, prompt=prompt, rid=self._next_rid)
+        self._next_rid += 1
+        self._submit_t[req.rid] = time.perf_counter()
+        self.waiting.append(req)
+        return req.rid
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def _finish(self, idx: int, reason: str):
+        act = self.slots[idx]
+        n = len(act.generated)
+        tpot = (act.decode_s / (n - 1)) if n > 1 else 0.0
+        self.finished[act.req.rid] = RequestResult(
+            rid=act.req.rid, tokens=np.asarray(act.generated, np.int32),
+            finish_reason=reason, ttft=act.first_tok_t - act.submit_t,
+            tpot=tpot)
+        self.slots[idx] = None
+
+    def _check_finished(self, idx: int, lengths):
+        """Retire the slot if its latest token ended the request.
+        ``lengths`` is the post-step host copy of the engine's per-slot
+        lengths — fetched ONCE per scheduler iteration by the caller (a
+        per-slot engine.slot_lengths() here would be a device->host
+        round-trip on the decode hot path, per slot per token)."""
+        act = self.slots[idx]
+        req = act.req
+        tok = act.generated[-1]
+        if req.eos_token_id is not None and tok == int(req.eos_token_id):
+            self._finish(idx, "eos")
+        elif len(act.generated) >= req.max_new_tokens:
+            self._finish(idx, "length")
+        elif int(lengths[idx]) >= self.engine.max_len:
+            # no room for another append — retire rather than overflow
+            self._finish(idx, "cache_full")
+
+    def admit(self) -> int:
+        """Fill free slots from the waiting queue (FIFO).  Each admission
+        is one bucketed prefill; returns how many were admitted."""
+        n = 0
+        for idx in range(self.engine.num_slots):
+            if self.slots[idx] is not None or not self.waiting:
+                continue
+            req = self.waiting.popleft()
+            # a request whose prompt+budget exceeds max_len is still
+            # admissible — generation just ends early with "cache_full"
+            tok, _logits = self.engine.prefill(
+                idx, req.prompt, temperature=req.temperature,
+                top_k=req.top_k, top_p=req.top_p)
+            now = time.perf_counter()
+            self.slots[idx] = _ActiveSlot(req, tok,
+                                          self._submit_t.pop(req.rid), now)
+            n += 1
+            self._check_finished(idx, self.engine.slot_lengths())
+        return n
+
+    def decode_once(self) -> int:
+        """One batched decode iteration over the active slots; returns the
+        number of tokens appended to live requests."""
+        active = [a is not None for a in self.slots]
+        if not any(active):
+            return 0
+        S = self.engine.num_slots
+        tokens = np.zeros((S,), np.int32)
+        temps = np.ones((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.ones((S,), np.float32)
+        for i, act in enumerate(self.slots):
+            if act is None:
+                continue
+            tokens[i] = act.generated[-1]
+            temps[i] = act.req.temperature
+            top_ks[i] = act.req.top_k
+            top_ps[i] = act.req.top_p
+        t0 = time.perf_counter()
+        next_tok, _logits = self.engine.decode(tokens, active, temps,
+                                               top_ks, top_ps)
+        t1 = time.perf_counter()
+        lengths = self.engine.slot_lengths()   # ONE host copy per step
+        n = 0
+        for i, act in enumerate(self.slots):
+            if act is None:
+                continue
+            act.generated.append(int(next_tok[i]))
+            act.decode_s += t1 - t0
+            act.last_t = t1
+            n += 1
+            self._check_finished(i, lengths)
+        return n
+
+    def step(self) -> int:
+        """One scheduler iteration: admit into free slots, then one
+        batched decode.  Returns tokens produced (prefill first-tokens
+        excluded)."""
+        self.admit()
+        return self.decode_once()
+
+    def run(self) -> Dict[int, RequestResult]:
+        """Drive to completion; returns {rid: RequestResult}.  Always
+        terminates: with work pending, admit() either fills a free slot
+        or all slots are active, and then decode_once() appends a token
+        to every active request, each of which is finite (max_new_tokens
+        / max_len eviction)."""
+        while self.waiting or any(a is not None for a in self.slots):
+            self.admit()
+            self.decode_once()
+        return self.finished
