@@ -92,6 +92,22 @@ def main():
     chosen = autotune.report()
     if chosen:
         result["autotune"] = chosen
+    # telemetry block (OBSERVABILITY.md): per-step wall-time percentiles
+    # from the histogram registry + compile counts from the recompile
+    # watchdog — the BENCH trajectory carries percentiles from now on
+    from paddle_tpu import observability as obs
+    h = obs.histogram("train.step_seconds")
+    result["metrics"] = {
+        "histograms": {
+            "train.step_seconds": {
+                "p50_ms": round(1e3 * h.percentile(0.50), 3),
+                "p95_ms": round(1e3 * h.percentile(0.95), 3),
+                "p99_ms": round(1e3 * h.percentile(0.99), 3),
+                "count": h.count,
+            },
+        },
+        "compile_counts": obs.compile_counts(),
+    }
     print(json.dumps(result))
 
 

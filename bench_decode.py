@@ -12,7 +12,12 @@ throughput counts every generated token (first tokens, which are
 prefill work, are reported separately via TTFT).  `compile_counts`
 asserts the structural claim this engine exists for: the decode step
 compiles EXACTLY ONCE no matter how many tokens are generated or how
-slots churn.
+slots churn — enforced by the recompile watchdog
+(paddle_tpu.observability.watchdog), which this bench arms in STRICT
+mode so any retrace raises at the step that caused it instead of being
+discovered in a summary line.  The `metrics` block carries p50/p95/p99
+TTFT/TPOT/queue-wait from the histogram registry (reset after warmup so
+percentiles describe the timed drain only).
 
 On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
 On CPU: the tiny config, so the bench always runs (numbers are smoke
@@ -28,6 +33,10 @@ import numpy as np
 
 
 def main():
+    # the watchdog IS the compile-count gate: any recompile of a watched
+    # entry (serving.decode budget: 1) raises RecompileError mid-drain
+    os.environ.setdefault("PADDLE_TPU_STRICT_COMPILE", "1")
+
     import jax
 
     import paddle_tpu as paddle
@@ -76,12 +85,27 @@ def main():
     # warmup drain: compiles prefill (one bucket) + the decode step once
     drive(min(num_slots, requests))
     engine.reset()
+    # percentiles must describe the TIMED drain, not the compile-heavy
+    # warmup — drop warmup samples.  reset() also zeroes the registry's
+    # compile.count shadow of the watchdog (whose ground truth, the jit
+    # cache sizes, survives) — resync so exports stay in agreement.
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import watchdog as _wd
+    obs.default_registry().reset()
+    _wd.resync_counter()
 
     results, dt = drive(requests)
     total_tokens = sum(r.tokens.size for r in results.values())
     ttft_ms = 1e3 * float(np.mean([r.ttft for r in results.values()]))
     tpot_ms = 1e3 * float(np.mean(
         [r.tpot for r in results.values() if r.tokens.size > 1]))
+
+    def _pcts(name):
+        h = obs.histogram(name)
+        return {"p50_ms": round(1e3 * h.percentile(0.50), 3),
+                "p95_ms": round(1e3 * h.percentile(0.95), 3),
+                "p99_ms": round(1e3 * h.percentile(0.99), 3),
+                "count": h.count}
 
     from paddle_tpu.kernels import autotune as at
     result = {
@@ -92,9 +116,23 @@ def main():
         "tpot_ms": round(tpot_ms, 3),
         "total_tokens": total_tokens,
         "wall_s": round(dt, 3),
+        # compile accounting now comes from the recompile watchdog (which
+        # also enforces the budget at runtime — strict mode above); the
+        # engine properties remain as a cross-check
         "compile_counts": {
             "decode": engine.decode_compile_count,
             "prefill": engine.prefill_compile_count,
+        },
+        "metrics": {
+            "histograms": {
+                "serving.ttft_seconds": _pcts("serving.ttft_seconds"),
+                "serving.tpot_seconds": _pcts("serving.tpot_seconds"),
+                "serving.queue_wait_seconds":
+                    _pcts("serving.queue_wait_seconds"),
+                "serving.decode_step_seconds":
+                    _pcts("serving.decode_step_seconds"),
+            },
+            "compile_counts": obs.compile_counts(),
         },
         "config": {
             "model": "gpt2_345m" if on_tpu else "tiny",
@@ -105,8 +143,6 @@ def main():
         },
         "autotune": at.report(),
     }
-    assert result["compile_counts"]["decode"] == 1, \
-        "decode step recompiled: %r" % (result["compile_counts"],)
     print(json.dumps(result))
 
 

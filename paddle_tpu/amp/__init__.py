@@ -168,7 +168,10 @@ class GradScaler:
         # this to tell "the fp16 gate already skipped the poisoned update"
         # (params intact — no rewind needed) from a real divergence
         self._last_skipped = self._found_inf
-        if not self._found_inf:
+        if self._found_inf:
+            from ..observability import registry as _metrics
+            _metrics.counter("train.amp_skipped_steps").inc()
+        else:
             optimizer.step()
         self._already_unscaled.discard(id(optimizer))
         self._update()

@@ -844,7 +844,13 @@ class _CompiledPipelineStep:
         # recorded for the trace-tier donation audit (TPU502): params and
         # opt_state are the two donated trees; a miss doubles peak HBM
         self._donate_argnums = (0, 1)
-        self._step = jax.jit(full_step, donate_argnums=self._donate_argnums)
+        # recompile watchdog: the 1F1B schedule is compile-once — a second
+        # program means the microbatch geometry is churning per step
+        from ..observability.watchdog import watch
+        self._step = watch(
+            "pipeline.1f1b_step",
+            jax.jit(full_step, donate_argnums=self._donate_argnums),
+            expected=1)
 
     def step(self, x, y, scale=None):
         x_a = x._array if isinstance(x, Tensor) else jnp.asarray(x)

@@ -15,6 +15,7 @@ from ..core.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..jit import TrainStep, functional_call
 from ..metric import Metric
+from ..observability import registry as _metrics
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRScheduler", "summary", "flops"]
@@ -226,6 +227,13 @@ class Model:
             cb.set_params({"epochs": epochs, "verbose": verbose})
         for cb in cbs:
             cb.on_train_begin()
+        # fit-loop telemetry (OBSERVABILITY.md): per-batch wall time here
+        # includes the loss fetch in train_batch — a real device sync — so
+        # unlike train.step_seconds (dispatch only) this is end-to-end
+        m_batch = _metrics.histogram("train.batch_seconds")
+        m_loss = _metrics.gauge("train.loss")
+        m_samples = _metrics.counter("train.samples")
+        m_tokens = _metrics.counter("train.tokens")
         it_count = 0
         for epoch in range(epochs):
             for cb in cbs:
@@ -233,7 +241,15 @@ class Model:
             logs = {}
             for step, batch in enumerate(train_loader):
                 ins, lbls = self._split_batch(batch)
+                t0 = time.perf_counter()
                 losses, _ = self.train_batch(ins, lbls)
+                m_batch.observe(time.perf_counter() - t0)
+                m_loss.set(losses[0])
+                shape = getattr(ins[0], "shape", None)
+                if shape:
+                    m_samples.inc(int(shape[0]))
+                    if len(shape) >= 2:
+                        m_tokens.inc(int(shape[0]) * int(shape[1]))
                 logs = {"loss": losses[0]}
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
@@ -397,6 +413,10 @@ def flops(net, input_size=None, inputs=None, dtypes=None, custom_ops=None,
         if was_training:
             net.train()
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        # jax <= 0.4.x returns one dict per device; flops are identical
+        # replicas on a single-program compile — take the first
+        ca = ca[0] if ca else {}
     total = int(ca.get("flops", 0))
     if print_detail:
         print(f"FLOPs (XLA cost analysis): {total:,}")

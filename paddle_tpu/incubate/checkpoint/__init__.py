@@ -282,6 +282,8 @@ class CheckpointManager:
         return f"host-{host}.manifest.json"
 
     def _write(self, step: int, payload):
+        from ...observability import registry as _metrics
+        t0 = time.perf_counter()
         final = os.path.join(self.directory, f"ckpt-{step}")
         tmp = final + ".tmp"
         if self._host == 0:
@@ -321,6 +323,11 @@ class CheckpointManager:
             with open(os.path.join(final, "DONE"), "w") as f:
                 f.write(str(self._nhosts))
             self._retain()
+        # recorded only for a COMPLETED save: an injected/real failure
+        # above propagates without polluting the duration histogram
+        _metrics.histogram("checkpoint.write_seconds").observe(
+            time.perf_counter() - t0)
+        _metrics.histogram("checkpoint.write_bytes").observe(writer.nbytes)
 
     def _verify_shards_before_publish(self, tmp: str, final: str):
         """Host 0, pre-DONE: every peer shard must be present in the SHARED
@@ -474,6 +481,8 @@ class CheckpointManager:
         complete checkpoint instead of raising on the first bad one.  Only
         :class:`NoUsableCheckpointError` escapes a fallback-enabled
         restore with candidates, and it names every failure."""
+        from ...observability import registry as _metrics
+        t0 = time.perf_counter()
         if step is None:
             candidates = list(reversed(self.all_steps()))
             if fallback is None:
@@ -506,7 +515,10 @@ class CheckpointManager:
                    "; ".join("ckpt-%d: %s: %s" % (s, type(e).__name__, e)
                              for s, e in failures)))
         tmpl = _to_template(template) if template is not None else None
-        return _from_host(merged, tmpl)
+        out = _from_host(merged, tmpl)
+        _metrics.histogram("checkpoint.restore_seconds").observe(
+            time.perf_counter() - t0)
+        return out
 
     def _read_step(self, step: int):
         """Read + integrity-verify + merge one checkpoint's shard files.

@@ -582,6 +582,14 @@ class TrainStep:
         # compiled step runs, including ZeRO layout constraints
         self._grads_core = grads_core
 
+        # opt-in grad-norm telemetry: computes the global grad norm inside
+        # the compiled step and publishes it as a gauge.  Costs one extra
+        # reduction in-program plus ONE device sync per step on the host —
+        # that is why it is an env opt-in, not a default
+        import os as _env_os
+        self._emit_grad_norm = _env_os.environ.get(
+            "PADDLE_TPU_METRICS_GRAD_NORM", "0") not in ("0", "", "off")
+
         def step_fn(params, buffers, opt_state, lr, rng, batch):
             loss, new_buffers, grads = grads_core(params, buffers, rng,
                                                   batch)
@@ -594,6 +602,11 @@ class TrainStep:
                     k: jax.lax.with_sharding_constraint(
                         p, NamedSharding(self._mesh, self._param_specs[k]))
                     for k, p in new_params.items()}
+            if self._emit_grad_norm:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+                return loss, new_params, new_buffers, new_opt_state, gnorm
             return loss, new_params, new_buffers, new_opt_state
 
         donate_args = (0, 1, 2) if donate else ()
@@ -603,7 +616,17 @@ class TrainStep:
         # as input-output aliasing in the compiled entry
         self._donate_argnums = donate_args
         self._step_fn = step_fn   # un-jitted, for audit re-wraps
-        self._step = jax.jit(step_fn, donate_argnums=donate_args)
+        # recompile watchdog: one TrainStep is one program — a second
+        # compile means a batch shape/dtype is churning underneath the
+        # caller (observability.watchdog warns; strict mode raises)
+        from ..observability import registry as _obs
+        from ..observability.watchdog import watch
+        self._step = watch("jit.train_step",
+                           jax.jit(step_fn, donate_argnums=donate_args),
+                           expected=1)
+        self._m_step_seconds = _obs.histogram("train.step_seconds")
+        self._m_steps = _obs.counter("train.steps")
+        self._m_grad_norm = _obs.gauge("train.grad_norm")
 
     def trace_args(self, batch):
         """The exact argument tuple ``self._step`` runs with, for
@@ -634,15 +657,29 @@ class TrainStep:
         if ctx is not None:
             batch_a = ctx["batch"]
         if self._in_shardings is not None and self._mesh is not None:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec
             specs = self._in_shardings
-            if not isinstance(specs, (list, tuple)):
+            # PartitionSpec IS a tuple: without the explicit check a single
+            # spec like PartitionSpec("sdp") would be unpacked into one
+            # raw axis-name STRING per batch element, which NamedSharding
+            # rejects (jax 0.4.x) or silently misreads
+            if isinstance(specs, PartitionSpec) or not isinstance(
+                    specs, (list, tuple)):
                 specs = [specs] * len(batch_a)
             batch_a = tuple(
                 jax.device_put(b, NamedSharding(self._mesh, s))
                 for b, s in zip(batch_a, specs))
-        loss, self.params, self.buffers, self.opt_state = self._step(
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self._step(
             self.params, self.buffers, self.opt_state, lr, rng, batch_a)
+        if self._emit_grad_norm:
+            loss, self.params, self.buffers, self.opt_state, gnorm = out
+            self._m_grad_norm.set(float(gnorm))   # opt-in: syncs the step
+        else:
+            loss, self.params, self.buffers, self.opt_state = out
+        self._m_step_seconds.observe(_time.perf_counter() - t0)
+        self._m_steps.inc()
         self._dirty = True
         if isinstance(self.optimizer._learning_rate, object) and hasattr(
                 self.optimizer._learning_rate, "step"):

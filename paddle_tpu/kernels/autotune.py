@@ -337,6 +337,8 @@ def tune(family_name: str, key: dict, persist: bool = True,
     samples = _samples()
     timings: Dict[str, Any] = {}
     best, best_ms = None, None
+    from ..observability import registry as _obs
+    _tune_t0 = time.perf_counter()
     try:
         with _record_event("autotune::%s::%s" % (family_name, ks)):
             for cand in cands:
@@ -363,6 +365,8 @@ def tune(family_name: str, key: dict, persist: bool = True,
                 if best_ms is None or ms < best_ms:
                     best, best_ms = cand, ms
     finally:
+        _obs.histogram("autotune.tune_seconds").observe(
+            time.perf_counter() - _tune_t0)
         if run_cleanup and fam.cleanup is not None:
             try:
                 fam.cleanup(key)
@@ -416,15 +420,18 @@ def resolve(family_name: str, key: dict) -> dict:
             _RESOLVED[(family_name, ks)] = cand
         return cand
 
+    from ..observability import registry as _obs
     pin = _pins().get(family_name)
     if pin is not None:
         default = fam.candidates(key)[0]
+        _obs.counter("autotune.cache_hits").inc()
         return _log({"variant": pin["variant"] or default["variant"],
                      "config": {**default["config"], **pin["config"]}})
     with _LOCK:
         hit = _MEMO.get((family_name, ks))
         if hit is not None:
             _RESOLVED[(family_name, ks)] = hit
+            _obs.counter("autotune.cache_hits").inc()
             return hit
         entry = _load_cache().get("families", {}).get(
             family_name, {}).get(ks)
@@ -433,7 +440,9 @@ def resolve(family_name: str, key: dict) -> dict:
                     "config": dict(entry["config"])}
             _MEMO[(family_name, ks)] = cand
             _RESOLVED[(family_name, ks)] = cand
+            _obs.counter("autotune.cache_hits").inc()
             return cand
+    _obs.counter("autotune.cache_misses").inc()
     if enabled() and fam.runner is not None and _single_process():
         return _log(tune(family_name, key))
     with _LOCK:

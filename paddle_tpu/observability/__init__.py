@@ -1,0 +1,42 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+The reference framework ships a full platform-layer observability stack
+(profiler scheduler windows, RecordEvent spans, chrome-trace export); this
+package is its metrics half for the TPU build, wired through every
+subsystem:
+
+* :mod:`.registry` — process-wide Counter / Gauge / Histogram registry:
+  thread-safe, host-side only (never traced — ``float()`` guard), no-op
+  singletons when disabled, fixed log-spaced histogram buckets with
+  p50/p95/p99 readout.
+* :mod:`.catalog` — the declared metric-name catalog (ops_schema-style:
+  the default registry rejects undeclared names; a test keeps catalog and
+  runtime emission in sync).
+* :mod:`.watchdog` — the recompile watchdog over the compile-once jit
+  entries (TrainStep, serving decode/prefill, 1F1B): counts compiles,
+  warns on budget violations, raises under ``PADDLE_TPU_STRICT_COMPILE=1``.
+* :mod:`.exporters` — Prometheus text, JSONL snapshots, chrome-trace
+  metric marks injected into the :mod:`paddle_tpu.profiler` stream.
+* CLI: ``python -m paddle_tpu.observability dump|serve|tail`` over the
+  JSONL snapshot stream (``PADDLE_TPU_METRICS_FILE``).
+
+Import discipline: this package must stay importable before (and without)
+jax — the registry is pure stdlib; jax-adjacent pieces (profiler marks)
+import lazily.  See OBSERVABILITY.md for the metric catalog and knobs.
+"""
+from __future__ import annotations
+
+from .catalog import CATALOG
+from .registry import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
+                       Gauge, Histogram, Registry, counter, default_registry,
+                       flush, gauge, histogram)
+from .watchdog import (RecompileError, RecompileWarning, WatchedEntry,
+                       compile_counts, watch)
+
+__all__ = [
+    "CATALOG", "Counter", "Gauge", "Histogram", "Registry",
+    "NOOP_COUNTER", "NOOP_GAUGE", "NOOP_HISTOGRAM",
+    "counter", "gauge", "histogram", "default_registry", "flush",
+    "RecompileError", "RecompileWarning", "WatchedEntry", "watch",
+    "compile_counts",
+]

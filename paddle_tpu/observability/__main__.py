@@ -1,0 +1,185 @@
+"""CLI over the JSONL metric-snapshot stream.
+
+    python -m paddle_tpu.observability dump  [--file P] [--format prom|json]
+    python -m paddle_tpu.observability tail  [--file P] [--follow] [--interval S]
+    python -m paddle_tpu.observability serve [--file P] [--port N]
+
+``--file`` defaults to ``$PADDLE_TPU_METRICS_FILE``.  ``dump`` renders the
+newest snapshot (Prometheus text by default); with no file configured it
+renders the current in-process default registry (useful after ``python -c
+"import workload; ..."``-style drivers).  ``tail`` prints one compact line
+per snapshot (and keeps following with ``--follow``).  ``serve`` exposes
+the newest snapshot at ``/metrics`` in Prometheus text format — point a
+scraper at a training/serving host without linking any client library.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import exporters, registry
+
+
+def _latest_snapshot(path):
+    """(ts, metrics) from the last well-formed line of a JSONL file."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                last = line
+    if last is None:
+        return None, None
+    doc = json.loads(last)
+    return doc.get("ts"), doc.get("metrics", {})
+
+
+def _render(metrics, fmt):
+    if fmt == "json":
+        return json.dumps(metrics, indent=1, sort_keys=True)
+    return exporters.to_prometheus(snapshot=metrics)
+
+
+def _summarize(doc) -> str:
+    """One compact human line per snapshot for ``tail``."""
+    metrics = doc.get("metrics", {})
+    parts = []
+    for name, entry in sorted(metrics.items()):
+        for series in entry["series"]:
+            labels = series.get("labels", {})
+            key = name + ("{%s}" % ",".join("%s=%s" % kv for kv in
+                                            sorted(labels.items()))
+                          if labels else "")
+            if entry["type"] == "histogram":
+                parts.append("%s: n=%d p50=%.4g p99=%.4g"
+                             % (key, series["count"], series["p50"],
+                                series["p99"]))
+            else:
+                parts.append("%s=%.6g" % (key, series["value"]))
+    ts = doc.get("ts")
+    stamp = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "-"
+    return "[%s] %s" % (stamp, "  ".join(parts) or "(empty)")
+
+
+def cmd_dump(args) -> int:
+    if args.file:
+        try:
+            _ts, metrics = _latest_snapshot(args.file)
+        except FileNotFoundError:
+            print("no snapshots in %s (file does not exist)" % args.file,
+                  file=sys.stderr)
+            return 1
+        if metrics is None:
+            print("no snapshots in %s" % args.file, file=sys.stderr)
+            return 1
+        print(_render(metrics, args.format), end="")
+    else:
+        print(_render(registry.default_registry().snapshot(), args.format),
+              end="")
+    return 0
+
+
+def cmd_tail(args) -> int:
+    if not args.file:
+        print("tail needs --file or PADDLE_TPU_METRICS_FILE",
+              file=sys.stderr)
+        return 2
+    pos = 0
+    try:
+        while True:
+            if os.path.exists(args.file):
+                with open(args.file) as f:
+                    f.seek(pos)
+                    while True:
+                        line = f.readline()
+                        if not line.endswith("\n"):
+                            break  # torn tail line: re-read next round
+                        pos = f.tell()
+                        if not line.strip():
+                            continue
+                        try:
+                            print(_summarize(json.loads(line)))
+                        except json.JSONDecodeError:
+                            pass  # malformed line: skip, keep following
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def make_server(path, port=0, in_process=False):
+    """The ``serve`` HTTP server (returned unstarted so tests can drive it
+    on an ephemeral port).  ``GET /metrics`` -> Prometheus text of the
+    newest snapshot (or the live in-process registry)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                if in_process or not path:
+                    body = exporters.to_prometheus(
+                        registry.default_registry())
+                else:
+                    _ts, metrics = _latest_snapshot(path)
+                    body = _render(metrics or {}, "prom")
+            except FileNotFoundError:
+                body = ""
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *a):
+            pass  # no per-request stderr spam
+
+    return HTTPServer(("127.0.0.1", port), Handler)
+
+
+def cmd_serve(args) -> int:
+    srv = make_server(args.file, args.port)
+    print("serving /metrics on http://127.0.0.1:%d (source: %s)"
+          % (srv.server_address[1], args.file or "in-process registry"))
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m paddle_tpu.observability")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    default_file = os.environ.get("PADDLE_TPU_METRICS_FILE")
+
+    d = sub.add_parser("dump", help="print the newest snapshot")
+    d.add_argument("--file", default=default_file)
+    d.add_argument("--format", choices=("prom", "json"), default="prom")
+    d.set_defaults(fn=cmd_dump)
+
+    t = sub.add_parser("tail", help="print one line per snapshot")
+    t.add_argument("--file", default=default_file)
+    t.add_argument("--follow", action="store_true")
+    t.add_argument("--interval", type=float, default=1.0)
+    t.set_defaults(fn=cmd_tail)
+
+    s = sub.add_parser("serve", help="HTTP /metrics endpoint")
+    s.add_argument("--file", default=default_file)
+    s.add_argument("--port", type=int, default=9464)
+    s.set_defaults(fn=cmd_serve)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""The metric-name catalog — every metric the framework emits at runtime,
+declared once (name, type, labels, unit, help).
+
+This is the observability analogue of ops_schema.yaml: the default
+registry refuses undeclared names at fetch time, and
+tests/test_observability.py exercises every instrumented subsystem and
+asserts the emitted set is covered here — so a dashboard never has to
+chase a metric that exists only in source code, and a stale catalog entry
+never outlives its instrumentation silently.
+
+Naming: dotted ``<subsystem>.<what>_<unit>`` internally; the Prometheus
+exporter rewrites dots to underscores (``serving.ttft_seconds`` ->
+``serving_ttft_seconds``).  Label value spaces are bounded by
+construction (finish reasons, bucket sizes, declared faultpoint sites,
+watchdog entry names).
+"""
+from __future__ import annotations
+
+__all__ = ["CATALOG"]
+
+
+def _m(type_, help_, labels=(), unit=""):
+    return {"type": type_, "help": help_, "labels": tuple(labels),
+            "unit": unit}
+
+
+CATALOG = {
+    # -- serving (engine + continuous-batching scheduler) -------------------
+    "serving.ttft_seconds": _m(
+        "histogram", "submit -> first token, per finished request "
+        "(INCLUDES admission-queue wait; subtract serving.queue_wait_seconds "
+        "for pure prefill latency)", unit="seconds"),
+    "serving.queue_wait_seconds": _m(
+        "histogram", "submit -> admission (prefill start), per request",
+        unit="seconds"),
+    "serving.tpot_seconds": _m(
+        "histogram", "mean seconds per token after the first, per finished "
+        "request", unit="seconds"),
+    "serving.decode_step_seconds": _m(
+        "histogram", "wall time of one batched decode iteration (all slots)",
+        unit="seconds"),
+    "serving.generated_tokens": _m(
+        "counter", "decode tokens appended to live requests (prefill "
+        "first-tokens excluded)"),
+    "serving.prefill_bucket_hits": _m(
+        "counter", "prefill admissions per power-of-two bucket",
+        labels=("bucket",)),
+    "serving.finished_requests": _m(
+        "counter", "retired requests by finish reason",
+        labels=("reason",)),
+    "serving.slot_occupancy": _m(
+        "gauge", "active slots after the latest scheduler iteration"),
+    "serving.queue_depth": _m(
+        "gauge", "requests waiting for admission"),
+
+    # -- training (TrainStep / hapi fit / amp / divergence sentinel) --------
+    "train.step_seconds": _m(
+        "histogram", "host wall time of one TrainStep call (dispatch; on "
+        "async backends completion is not awaited)", unit="seconds"),
+    "train.batch_seconds": _m(
+        "histogram", "hapi fit per-batch wall time incl. the loss fetch "
+        "(a real device sync)", unit="seconds"),
+    "train.steps": _m("counter", "TrainStep calls"),
+    "train.samples": _m("counter", "leading-dim samples seen by hapi fit"),
+    "train.tokens": _m(
+        "counter", "batch*seq tokens seen by hapi fit (2-D+ inputs only)"),
+    "train.loss": _m("gauge", "last training loss hapi fit observed"),
+    "train.grad_norm": _m(
+        "gauge", "global gradient norm (opt-in: "
+        "PADDLE_TPU_METRICS_GRAD_NORM=1 at TrainStep construction; forces "
+        "one device sync per step)"),
+    "train.amp_skipped_steps": _m(
+        "counter", "optimizer updates the GradScaler skipped on found_inf"),
+    "train.divergence_rollbacks": _m(
+        "counter", "DivergenceSentinel rewinds to a snapshot"),
+
+    # -- robustness (retry policy, chaos faultpoints) -----------------------
+    "robustness.retry_attempts": _m(
+        "counter", "retries scheduled by retry_call (first attempts are "
+        "not counted; exhaustion raises RetryError)", labels=("op",)),
+    "robustness.faultpoint_fires": _m(
+        "counter", "injected faults fired by the active FaultPlan",
+        labels=("site",)),
+
+    # -- checkpoint ---------------------------------------------------------
+    "checkpoint.write_seconds": _m(
+        "histogram", "full checkpoint save (serialize + shard write + "
+        "manifest + publish)", unit="seconds"),
+    "checkpoint.write_bytes": _m(
+        "histogram", "bytes per checkpoint save (manifest-intended bytes)",
+        unit="bytes"),
+    "checkpoint.restore_seconds": _m(
+        "histogram", "checkpoint restore (read + verify + deserialize)",
+        unit="seconds"),
+
+    # -- kernels / autotune -------------------------------------------------
+    "autotune.cache_hits": _m(
+        "counter", "resolve() served from pin/memo/persistent cache"),
+    "autotune.cache_misses": _m(
+        "counter", "resolve() fell through to timed tuning or the "
+        "registered default"),
+    "autotune.tune_seconds": _m(
+        "histogram", "wall time of one timed candidate selection",
+        unit="seconds"),
+
+    # -- compile watchdog ---------------------------------------------------
+    "compile.count": _m(
+        "counter", "XLA compilations per watched jit entry (the recompile "
+        "watchdog warns/raises when a compile-once entry exceeds its "
+        "budget)", labels=("entry",)),
+}

@@ -1,0 +1,177 @@
+"""The recompile watchdog — turn silent retraces into a loud runtime signal.
+
+The serving engine's headline bug class (PR 5: a per-token retrace of the
+decode step that cost ~100x throughput and was invisible for five PRs) is
+structural: jax.jit happily compiles a fresh program for every new
+argument-shape/dtype signature, and nothing in the runtime says so.  The
+watchdog instruments the compile-once entry points — ``TrainStep``,
+serving decode/prefill, the 1F1B pipeline step — by checking the jit's
+program-cache size after every call:
+
+* every growth increments ``compile.count{entry=<name>}`` in the default
+  metrics registry (so bench JSON lines and Prometheus scrapes carry
+  compile counts from now on), and
+* growth past the entry's ``expected`` budget emits ONE structured
+  :class:`RecompileWarning` per excess compile — or raises
+  :class:`RecompileError` immediately under ``PADDLE_TPU_STRICT_COMPILE=1``
+  (the CI bench-smoke mode).
+
+``watch()`` wraps the jitted callable transparently: attribute access
+(``_cache_size``, ``lower``, ...) is delegated, so existing audit hooks
+and compile-count properties keep working on a watched entry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+import weakref
+from typing import Callable, Dict, Optional
+
+from . import registry as _registry
+
+__all__ = ["RecompileWarning", "RecompileError", "WatchedEntry", "watch",
+           "compile_counts", "resync_counter", "strict_mode"]
+
+
+class RecompileWarning(UserWarning):
+    """A supposedly compile-once jit entry compiled again at runtime."""
+
+
+class RecompileError(RuntimeError):
+    """Strict-mode (PADDLE_TPU_STRICT_COMPILE=1) recompile failure.
+
+    Fatal by design — a CI/bench kill switch, not a recoverable signal:
+    the offending call has already EXECUTED when the cache growth is
+    detected, so for entries with donated operands (TrainStep, serving
+    decode) the caller's input buffers are consumed and the step's output
+    is discarded with the raise.  Catching this to log-and-continue will
+    hit deleted-buffer errors on the next call; let it terminate the run.
+    """
+
+
+def strict_mode() -> bool:
+    return os.environ.get("PADDLE_TPU_STRICT_COMPILE", "0") not in (
+        "0", "", "false", "off")
+
+
+#: process-wide table of watched entries: name -> [weakref, ...] (several
+#: engines may watch the same logical entry name; counts sum).  Weak on
+#: purpose: a WatchedEntry holds the jit, which holds its compiled
+#: programs AND the model closure — a strong global table would pin every
+#: TrainStep/engine ever built for the life of the process.
+_ENTRIES: Dict[str, list] = {}
+_ENTRIES_LOCK = threading.Lock()
+
+
+class WatchedEntry:
+    """A jitted callable plus its compile budget.  Call it like the jit;
+    every program-cache growth is metered and budget-checked."""
+
+    def __init__(self, name: str, fn: Callable,
+                 expected: Optional[int] = None):
+        self._name = name
+        self._fn = fn
+        self._expected = expected
+        self._seen = self._raw_cache_size()
+        self._counter = _registry.counter("compile.count", ("entry",))
+        self._lock = threading.Lock()
+        with _ENTRIES_LOCK:
+            refs = _ENTRIES.setdefault(name, [])
+            refs[:] = [r for r in refs if r() is not None]
+            refs.append(weakref.ref(self))
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def entry_name(self) -> str:
+        return self._name
+
+    @property
+    def compile_count(self) -> int:
+        """Programs this entry's jit cache holds right now."""
+        return self._raw_cache_size()
+
+    def _raw_cache_size(self) -> int:
+        try:
+            return int(self._fn._cache_size())
+        except Exception:
+            return 0
+
+    def __getattr__(self, name):
+        # transparent delegation: audit hooks (.lower), the engine's
+        # _cache_size-based properties, functools metadata all pass through
+        fn = self.__dict__.get("_fn")
+        if fn is None:
+            raise AttributeError(name)
+        return getattr(fn, name)
+
+    # -- the metered call --------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        n = self._raw_cache_size()
+        if n != self._seen:
+            self._on_growth(n)
+        return out
+
+    def _on_growth(self, n: int):
+        with self._lock:
+            grew = n - self._seen
+            if grew <= 0:       # cache cleared/shrunk: resync, no event
+                self._seen = n
+                return
+            self._seen = n
+        self._counter.labels(entry=self._name).inc(grew)
+        if self._expected is not None and n > self._expected:
+            payload = json.dumps({
+                "event": "recompile", "entry": self._name,
+                "compile_count": n, "expected": self._expected}, sort_keys=True)
+            if strict_mode():
+                raise RecompileError(
+                    "compile-once violation: %s — the jit entry %r now "
+                    "holds %d programs (budget %d); an argument "
+                    "shape/dtype/structure is varying across calls"
+                    % (payload, self._name, n, self._expected))
+            warnings.warn(
+                "RECOMPILE %s — entry %r compiled %d time(s) against a "
+                "budget of %d; a supposedly-static argument is varying "
+                "(set PADDLE_TPU_STRICT_COMPILE=1 to make this fatal)"
+                % (payload, self._name, n, self._expected),
+                RecompileWarning, stacklevel=3)
+
+
+def watch(name: str, fn: Callable,
+          expected: Optional[int] = None) -> WatchedEntry:
+    """Wrap a jitted callable as a watched entry.  ``expected`` is the
+    compile budget (1 for compile-once entries, ``len(buckets)`` for the
+    bucketed prefill, None to meter without a budget)."""
+    return WatchedEntry(name, fn, expected)
+
+
+def compile_counts() -> Dict[str, int]:
+    """{entry name: total programs held} across every live watched entry
+    in the process — what bench.py / bench_decode.py attach to their JSON
+    lines."""
+    with _ENTRIES_LOCK:
+        items = [(name, [e for e in (r() for r in refs) if e is not None])
+                 for name, refs in sorted(_ENTRIES.items())]
+    return {name: sum(e.compile_count for e in entries)
+            for name, entries in items if entries}
+
+
+def resync_counter():
+    """Re-align ``compile.count{entry=}`` with the live jit cache sizes.
+
+    The watchdog's ground truth is the cache size; the registry counter is
+    its exported shadow.  After ``Registry.reset()`` (e.g. a bench dropping
+    warmup samples) the shadow reads 0 while the caches still hold their
+    programs — call this to bring Prometheus/JSONL exports back into
+    agreement with :func:`compile_counts`."""
+    c = _registry.counter("compile.count", ("entry",))
+    for name, n in compile_counts().items():
+        leaf = c.labels(entry=name)
+        delta = n - leaf.value
+        if delta > 0:
+            leaf.inc(delta)

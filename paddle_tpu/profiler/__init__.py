@@ -77,6 +77,26 @@ class _HostEventRecorder:
 
 _recorder = _HostEventRecorder()
 
+#: chrome-trace counter marks injected by paddle_tpu.observability:
+#: (name, ts_ns, value) triples, exported as "ph": "C" events so metric
+#: tracks render time-aligned under the host spans.
+_metric_marks = []
+
+#: backstop bound on the mark buffer: export_chrome_tracing drains it, but
+#: a custom on_trace_ready callback may not — keep only the newest marks
+#: so an undrained buffer can never grow for the life of the process.
+_MARKS_CAP = 100_000
+
+
+def _inject_metric_marks():
+    """Snapshot the default metrics registry into the mark buffer (no-op
+    when observability is disabled or unavailable)."""
+    try:
+        from ..observability.exporters import inject_profiler_marks
+        inject_profiler_marks()
+    except Exception:
+        pass  # metrics must never break a trace export
+
 
 class RecordEvent:
     """Span instrumentation (reference: platform::RecordEvent; hooks sat in
@@ -119,6 +139,12 @@ def export_chrome_tracing(dir_name: str, worker_name: str = None):
             "name": name, "ph": "X", "ts": ts / 1000.0, "dur": dur / 1000.0,
             "pid": os.getpid(), "tid": tid, "cat": "host",
         } for name, ts, dur, tid in prof._drained_events]
+        marks, _metric_marks[:] = list(_metric_marks), []
+        events.extend({
+            "name": name, "ph": "C", "ts": ts / 1000.0,
+            "pid": os.getpid(), "cat": "metric",
+            "args": {"value": value},
+        } for name, ts, value in marks)
         with open(fname, "w") as f:
             json.dump({"traceEvents": events}, f)
         prof._last_export = fname
@@ -161,6 +187,9 @@ class Profiler:
             self._jax_tracing = False
         self._drained_events.extend(_recorder.drain())
         if self._on_trace_ready:
+            # marks exist solely for the trace-export stream: injecting
+            # with no consumer would strand them in the module buffer
+            _inject_metric_marks()
             self._on_trace_ready(self)
 
     def step(self, num_samples=None):
@@ -171,6 +200,7 @@ class Profiler:
             if self._state == ProfilerState.RECORD_AND_RETURN:
                 self._drained_events.extend(_recorder.drain())
                 if self._on_trace_ready:
+                    _inject_metric_marks()
                     self._on_trace_ready(self)
 
     def step_info(self, unit="samples"):

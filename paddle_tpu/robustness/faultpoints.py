@@ -302,6 +302,10 @@ class FaultPlan:
                 self.fired.append((site, index, repr(r.action)))
         # fire OUTSIDE the lock: an action may block, exit, or re-enter
         # another faultpoint via the recovery path it triggers
+        if due:
+            from ..observability import registry as _metrics
+            _metrics.counter("robustness.faultpoint_fires",
+                             ("site",)).labels(site=site).inc(len(due))
         for r in due:
             r.action.fire(ctx, self)
         return ctx

@@ -131,7 +131,13 @@ def retry_call(fn: Callable, *args,
         matcher = lambda e: isinstance(e, excs)
     else:
         matcher = retry_on
-    label = name or getattr(fn, "__qualname__", None) or repr(fn)
+    # the label feeds the robustness.retry_attempts{op=} metric, whose
+    # value space must stay bounded (catalog contract): never repr(fn) —
+    # that embeds a memory address, minting a fresh series per callable
+    # object.  functools.partial unwraps one level to the target's name.
+    target = getattr(fn, "func", fn)
+    label = (name or getattr(fn, "__qualname__", None)
+             or getattr(target, "__qualname__", None) or type(fn).__name__)
     delays = backoff_delays(base_delay, cap=max_delay, jitter=jitter, rng=rng)
     start = time.monotonic()
     attempt = 0
@@ -150,6 +156,9 @@ def retry_call(fn: Callable, *args,
             delay = next(delays)
             if deadline is not None:
                 delay = min(delay, max(0.0, deadline - elapsed))
+            from ..observability import registry as _metrics
+            _metrics.counter("robustness.retry_attempts",
+                             ("op",)).labels(op=label).inc()
             if on_retry is not None:
                 on_retry(e, attempt, delay)
             sleep(delay)

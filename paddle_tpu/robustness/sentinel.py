@@ -158,6 +158,8 @@ class DivergenceSentinel:
         self._skip_streak = 0
         self.rewinds.append((int(bad_step) if bad_step is not None else -1,
                              snap["step"], bad_loss))
+        from ..observability import registry as _metrics
+        _metrics.counter("train.divergence_rollbacks").inc()
         warnings.warn(
             "divergence at step %s (loss=%r): rewound training state to "
             "step %d (%d snapshot(s) left)"

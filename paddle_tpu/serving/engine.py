@@ -144,10 +144,20 @@ class DecodeEngine:
         self._decode_donate_argnums = (1, 2, 3) if donate else ()
         self._prefill_fn = prefill_fn
         self._prefill_donate_argnums = (4, 5, 6) if donate else ()
-        self._decode = jax.jit(decode_fn,
-                               donate_argnums=self._decode_donate_argnums)
-        self._prefill = jax.jit(prefill_fn,
-                                donate_argnums=self._prefill_donate_argnums)
+        # recompile watchdog (observability.watchdog): decode is the
+        # compile-ONCE entry — a second program is PR 5's silent-retrace
+        # bug class and warns (raises under PADDLE_TPU_STRICT_COMPILE=1);
+        # prefill's budget is its bucket count
+        from ..observability.watchdog import watch
+        self._decode = watch(
+            "serving.decode",
+            jax.jit(decode_fn, donate_argnums=self._decode_donate_argnums),
+            expected=1)
+        self._prefill = watch(
+            "serving.prefill",
+            jax.jit(prefill_fn,
+                    donate_argnums=self._prefill_donate_argnums),
+            expected=len(self.buckets))
 
     # -- host-side API -----------------------------------------------------
 

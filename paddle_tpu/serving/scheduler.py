@@ -15,8 +15,15 @@ powers of two (``engine.buckets``) so the prefill jit cache is bounded
 by ``log2(max_len)`` programs.
 
 Per-request timing is recorded for the serving metrics the bench emits:
-TTFT (submit → first token, includes queue wait) and TPOT (mean decode
-seconds per subsequent token).
+TTFT (submit → first token — still INCLUDES queue wait, for continuity
+with the PR-5 trajectory), ``queue_wait`` (submit → admission, reported
+separately so load tests can subtract it: under saturation TTFT is
+dominated by queueing, not prefill), and TPOT (mean decode seconds per
+subsequent token).  Every iteration also feeds the process-wide metrics
+registry (paddle_tpu.observability — TTFT/TPOT/queue-wait histograms,
+slot occupancy, prefill bucket hits, finish reasons, tokens); handles are
+fetched once at construction, so with metrics disabled the per-token path
+is a no-op method call with zero host allocation.
 """
 from __future__ import annotations
 
@@ -26,6 +33,8 @@ from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from ..observability import registry as _metrics
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
 
@@ -48,19 +57,21 @@ class RequestResult:
     finish_reason: str                   # "eos" | "length" | "cache_full"
     ttft: float                          # submit -> first token, seconds
     tpot: float                          # mean secs/token after the first
+    queue_wait: float = 0.0              # submit -> admission, seconds
 
 
 class _ActiveSlot:
     __slots__ = ("req", "generated", "submit_t", "first_tok_t", "last_t",
-                 "decode_s")
+                 "decode_s", "queue_wait")
 
-    def __init__(self, req, first_token, submit_t, now):
+    def __init__(self, req, first_token, submit_t, now, queue_wait=0.0):
         self.req = req
         self.generated = [int(first_token)]
         self.submit_t = submit_t
         self.first_tok_t = now
         self.last_t = now
         self.decode_s = 0.0
+        self.queue_wait = queue_wait
 
 
 class ContinuousBatchingScheduler:
@@ -71,6 +82,22 @@ class ContinuousBatchingScheduler:
         self.finished: Dict[int, RequestResult] = {}
         self._next_rid = 0
         self._submit_t: Dict[int, float] = {}
+        # metric handles, fetched ONCE: with the registry disabled these
+        # are the shared no-op singletons — the per-token hot path then
+        # does nothing and allocates nothing (tests/test_observability.py
+        # asserts the identity)
+        self._m_ttft = _metrics.histogram("serving.ttft_seconds")
+        self._m_queue_wait = _metrics.histogram("serving.queue_wait_seconds")
+        self._m_tpot = _metrics.histogram("serving.tpot_seconds")
+        self._m_decode_step = _metrics.histogram(
+            "serving.decode_step_seconds")
+        self._m_tokens = _metrics.counter("serving.generated_tokens")
+        self._m_bucket_hits = _metrics.counter(
+            "serving.prefill_bucket_hits", ("bucket",))
+        self._m_finished = _metrics.counter(
+            "serving.finished_requests", ("reason",))
+        self._m_occupancy = _metrics.gauge("serving.slot_occupancy")
+        self._m_queue_depth = _metrics.gauge("serving.queue_depth")
 
     # -- intake ------------------------------------------------------------
 
@@ -88,6 +115,7 @@ class ContinuousBatchingScheduler:
         self._next_rid += 1
         self._submit_t[req.rid] = time.perf_counter()
         self.waiting.append(req)
+        self._m_queue_depth.set(len(self.waiting))
         return req.rid
 
     # -- slot lifecycle ----------------------------------------------------
@@ -96,11 +124,16 @@ class ContinuousBatchingScheduler:
         act = self.slots[idx]
         n = len(act.generated)
         tpot = (act.decode_s / (n - 1)) if n > 1 else 0.0
+        ttft = act.first_tok_t - act.submit_t
         self.finished[act.req.rid] = RequestResult(
             rid=act.req.rid, tokens=np.asarray(act.generated, np.int32),
-            finish_reason=reason, ttft=act.first_tok_t - act.submit_t,
-            tpot=tpot)
+            finish_reason=reason, ttft=ttft, tpot=tpot,
+            queue_wait=act.queue_wait)
         self.slots[idx] = None
+        self._m_finished.labels(reason=reason).inc()
+        self._m_ttft.observe(ttft)
+        if n > 1:
+            self._m_tpot.observe(tpot)
 
     def _check_finished(self, idx: int, lengths):
         """Retire the slot if its latest token ended the request.
@@ -129,14 +162,24 @@ class ContinuousBatchingScheduler:
             req = self.waiting.popleft()
             # a request whose prompt+budget exceeds max_len is still
             # admissible — generation just ends early with "cache_full"
+            submit_t = self._submit_t.pop(req.rid)
+            admit_t = time.perf_counter()
+            queue_wait = admit_t - submit_t
+            self._m_queue_wait.observe(queue_wait)
+            self._m_bucket_hits.labels(
+                bucket=self.engine.bucket_for(req.prompt.size)).inc()
             tok, _logits = self.engine.prefill(
                 idx, req.prompt, temperature=req.temperature,
                 top_k=req.top_k, top_p=req.top_p)
             now = time.perf_counter()
-            self.slots[idx] = _ActiveSlot(req, tok,
-                                          self._submit_t.pop(req.rid), now)
+            self.slots[idx] = _ActiveSlot(req, tok, submit_t, now,
+                                          queue_wait)
             n += 1
             self._check_finished(idx, self.engine.slot_lengths())
+        if n:
+            self._m_queue_depth.set(len(self.waiting))
+            self._m_occupancy.set(
+                sum(a is not None for a in self.slots))
         return n
 
     def decode_once(self) -> int:
@@ -171,6 +214,11 @@ class ContinuousBatchingScheduler:
             act.last_t = t1
             n += 1
             self._check_finished(i, lengths)
+        # per-ITERATION metrics (not per token): one histogram observe,
+        # one counter inc, one gauge set per batched step
+        self._m_decode_step.observe(t1 - t0)
+        self._m_tokens.inc(n)
+        self._m_occupancy.set(sum(a is not None for a in self.slots))
         return n
 
     def step(self) -> int:

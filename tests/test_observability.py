@@ -1,0 +1,644 @@
+"""The unified-telemetry suite (ISSUE 6): metrics registry semantics,
+catalog coverage (ops_schema-style), the no-op fast path, the never-traced
+guard, the recompile watchdog (quiet + failure paths), exporters
+(Prometheus / JSONL / chrome-trace marks), and the CLI."""
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import (CATALOG, NOOP_COUNTER, NOOP_GAUGE,
+                                      NOOP_HISTOGRAM, Registry, watchdog)
+from paddle_tpu.observability import exporters, registry as reg_mod
+
+
+# ---------------------------------------------------------------------------
+# registry core
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics_and_labels():
+    reg = Registry(catalog=None)
+    c = reg.counter("events", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc()
+    assert c.labels(kind="a").value == 3.0
+    assert c.labels(kind="b").value == 1.0
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)          # counters are monotonic
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")                 # undeclared label key
+    g = reg.gauge("depth")
+    g.set(4)
+    g.dec()
+    assert g.value == 3.0
+
+
+def test_histogram_percentiles_within_bucket_resolution():
+    reg = Registry(catalog=None)
+    h = reg.histogram("lat")
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(1e-3, 1.0, size=2000)
+    for v in vals:
+        h.observe(float(v))
+    for q in (0.5, 0.95, 0.99):
+        exact = float(np.quantile(vals, q))
+        est = h.percentile(q)
+        # log-spaced buckets at 12/decade => ~21% max relative error
+        assert abs(est - exact) / exact < 0.25, (q, est, exact)
+    assert h.count == 2000
+    assert abs(h.sum - float(vals.sum())) < 1e-6
+    # readout never leaves the observed range (open-ended edge buckets)
+    assert min(vals) <= h.percentile(0.0) <= h.percentile(1.0) <= max(vals)
+
+
+def test_histogram_empty_and_extremes():
+    reg = Registry(catalog=None)
+    h = reg.histogram("x")
+    assert h.percentile(0.5) == 0.0
+    h.observe(0.0)            # below the first bound -> bucket 0
+    h.observe(1e15)           # beyond the last bound -> overflow bucket
+    assert h.count == 2
+    assert h.percentile(1.0) == 1e15
+
+
+def test_reset_zeroes_in_place_and_keeps_handles_live():
+    """reset() must NOT drop the metric objects: components fetch handles
+    once at construction (scheduler, watchdog), so a reset that cleared
+    the dict would orphan every live handle — recordings after a
+    bench-style warmup reset would silently vanish from snapshots."""
+    reg = Registry(catalog=None)
+    c = reg.counter("events", labels=("kind",))
+    h = reg.histogram("lat")
+    g = reg.gauge("depth")
+    c.labels(kind="a").inc(3)
+    h.observe(0.5)
+    g.set(7)
+    reg.reset()
+    # values zeroed ...
+    assert c.labels(kind="a").value == 0.0
+    assert h.count == 0 and h.percentile(0.5) == 0.0
+    assert g.value == 0.0
+    # ... but the SAME objects keep recording and stay visible
+    assert reg.counter("events", labels=("kind",)) is c
+    c.labels(kind="a").inc()
+    h.observe(0.25)
+    snap = reg.snapshot()
+    assert snap["events"]["series"][0]["value"] == 1.0
+    assert snap["lat"]["series"][0]["count"] == 1
+
+
+def test_disabled_fetch_still_validates_catalog():
+    """Catalog strictness holds in metrics-off deployments too: fetches
+    happen at construction (not the hot path), so a typo'd name should
+    fail regardless of PADDLE_TPU_METRICS."""
+    reg = obs.default_registry()
+    assert reg.enabled, "suite assumes metrics on"
+    reg.disable()
+    try:
+        with pytest.raises(ValueError, match="not declared"):
+            reg.counter("definitely.not.declared")
+        assert reg.counter("serving.finished_requests") is NOOP_COUNTER
+    finally:
+        reg.enable()
+
+
+def test_registry_thread_safety_under_contention():
+    reg = Registry(catalog=None)
+    c = reg.counter("n")
+    h = reg.histogram("h")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000.0
+    assert h.count == 8000
+
+
+# ---------------------------------------------------------------------------
+# catalog (ops_schema-style surface check)
+# ---------------------------------------------------------------------------
+
+def test_default_registry_rejects_undeclared_names():
+    with pytest.raises(ValueError, match="not declared"):
+        obs.counter("definitely.not.declared")
+    with pytest.raises(ValueError, match="declared as a"):
+        obs.gauge("serving.ttft_seconds")   # declared as histogram
+    with pytest.raises(ValueError, match="labels"):
+        obs.counter("serving.finished_requests", ("nope",))
+
+
+def test_catalog_entries_are_well_formed():
+    assert CATALOG, "catalog must not be empty"
+    for name, spec in CATALOG.items():
+        assert spec["type"] in ("counter", "gauge", "histogram"), name
+        assert isinstance(spec["help"], str) and spec["help"], name
+        assert isinstance(spec["labels"], tuple), name
+
+
+def test_runtime_emission_is_covered_by_catalog():
+    """Exercise every instrumented subsystem, then assert (a) everything
+    emitted is declared and (b) the core per-subsystem names actually
+    showed up — a stale catalog entry whose instrumentation was deleted
+    still fails CI through the expected-name list below."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+    from paddle_tpu.robustness import retry
+    from paddle_tpu.robustness.faultpoints import (FaultPlan, SocketReset,
+                                                   chaos, declare)
+    from paddle_tpu.kernels import autotune as at
+    from paddle_tpu.kernels import norm_pallas as nop
+
+    reg = obs.default_registry()
+    assert reg.enabled, "suite assumes metrics on (PADDLE_TPU_METRICS)"
+
+    # serving
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    model = GPTForCausalLM(cfg)
+    engine = DecodeEngine(model, num_slots=2, max_len=64, seed=0)
+    sched = ContinuousBatchingScheduler(engine)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        sched.submit(Request(prompt=rng.integers(0, cfg.vocab_size, (8,)),
+                             max_new_tokens=3, temperature=0.0))
+    sched.run()
+
+    # training (TrainStep dispatch metrics)
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    net = nn.Sequential(nn.Linear(4, 4))
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt)
+    x = jnp.ones((2, 4), jnp.float32)
+    step(x, x)
+
+    # robustness: one retried transient + one injected fault
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    retry.retry_call(flaky, tries=3, sleep=lambda d: None)
+    declare("test.obs_site", "observability coverage probe")
+    with chaos(FaultPlan(seed=0).inject("test.obs_site", SocketReset(),
+                                        at=0)):
+        from paddle_tpu.robustness.faultpoints import faultpoint
+        with pytest.raises(ConnectionResetError):
+            faultpoint("test.obs_site")
+
+    # autotune resolve (hit-or-miss path)
+    at.resolve("ln", nop.autotune_key(8, 64, jnp.float32))
+
+    snap = reg.snapshot()
+    undeclared = set(snap) - set(CATALOG)
+    assert not undeclared, "runtime metrics missing from catalog: %s" % (
+        sorted(undeclared),)
+    for expected in ("serving.ttft_seconds", "serving.queue_wait_seconds",
+                     "serving.generated_tokens", "serving.finished_requests",
+                     "serving.prefill_bucket_hits", "serving.slot_occupancy",
+                     "train.step_seconds", "train.steps",
+                     "robustness.retry_attempts",
+                     "robustness.faultpoint_fires", "compile.count"):
+        assert expected in snap, "instrumentation for %r never fired" % (
+            expected,)
+    assert ("autotune.cache_hits" in snap or "autotune.cache_misses"
+            in snap), "autotune resolve emitted no cache metrics"
+
+
+# ---------------------------------------------------------------------------
+# disabled => no-op fast path, no per-token host allocation
+# ---------------------------------------------------------------------------
+
+def test_disabled_registry_hands_out_noop_singletons():
+    reg = Registry(catalog=None, enabled=False)
+    assert reg.counter("a") is NOOP_COUNTER
+    assert reg.gauge("b") is NOOP_GAUGE
+    assert reg.histogram("c") is NOOP_HISTOGRAM
+    # and the noops are inert under every method
+    NOOP_COUNTER.inc()
+    NOOP_COUNTER.labels(anything="x").inc(5)
+    NOOP_HISTOGRAM.observe(1.0)
+    assert NOOP_COUNTER.value == 0.0
+    assert NOOP_HISTOGRAM.count == 0
+
+
+def test_disabled_metrics_scheduler_hot_loop_is_noop():
+    """Acceptance: registry disabled => the instrumented decode loop holds
+    the shared no-op singletons by IDENTITY (no allocation, no recording
+    on the per-token path) and live handles stop recording too."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+
+    reg = obs.default_registry()
+    live = reg.histogram("serving.ttft_seconds")
+    before = live.count
+    reg.disable()
+    try:
+        cfg = GPTConfig.tiny()
+        cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+        engine = DecodeEngine(GPTForCausalLM(cfg), num_slots=2, max_len=64,
+                              seed=0)
+        sched = ContinuousBatchingScheduler(engine)
+        assert sched._m_ttft is NOOP_HISTOGRAM
+        assert sched._m_tokens is NOOP_COUNTER
+        assert sched._m_decode_step is NOOP_HISTOGRAM
+        assert sched._m_occupancy is NOOP_GAUGE
+        rng = np.random.default_rng(0)
+        sched.submit(Request(prompt=rng.integers(0, cfg.vocab_size, (8,)),
+                             max_new_tokens=3, temperature=0.0))
+        sched.run()
+        # a pre-disable live handle records nothing while disabled
+        live.observe(1.0)
+        assert live.count == before
+    finally:
+        reg.enable()
+
+
+# ---------------------------------------------------------------------------
+# never traced
+# ---------------------------------------------------------------------------
+
+def test_registry_rejects_traced_values():
+    reg = Registry(catalog=None)
+    h = reg.histogram("h")
+    c = reg.counter("c")
+
+    def bad_hist(x):
+        h.observe(x)
+        return x
+
+    def bad_counter(x):
+        c.inc(x)
+        return x
+
+    with pytest.raises(RuntimeError, match="host-side only"):
+        jax.jit(bad_hist)(jnp.ones(()))
+    with pytest.raises(RuntimeError, match="host-side only"):
+        jax.jit(bad_counter)(jnp.ones(()))
+
+
+def test_observability_package_never_imported_by_traced_kernels():
+    """Lint-style guard: the Pallas kernel modules (whose bodies run under
+    tracing) must not import the registry at all."""
+    import pathlib
+    kdir = pathlib.Path(__file__).resolve().parent.parent / "paddle_tpu" \
+        / "kernels"
+    for f in kdir.glob("*_pallas.py"):
+        assert "observability" not in f.read_text(), \
+            "%s must stay registry-free (kernel bodies are traced)" % f.name
+
+
+# ---------------------------------------------------------------------------
+# recompile watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_quiet_path_decode_compiles_once_across_slot_churn():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    engine = DecodeEngine(GPTForCausalLM(cfg), num_slots=2, max_len=64,
+                          seed=0)
+    sched = ContinuousBatchingScheduler(engine)
+    rng = np.random.default_rng(1)
+    # more requests than slots + mixed lengths/budgets => admissions,
+    # evictions, re-admissions — real slot churn
+    for i in range(6):
+        sched.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (4 + 3 * (i % 3),)),
+            max_new_tokens=2 + (i % 3), temperature=0.0))
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error", watchdog.RecompileWarning)
+        results = sched.run()
+    assert len(results) == 6
+    assert engine.decode_compile_count == 1
+    assert engine.prefill_compile_count <= len(engine.buckets)
+
+
+def test_watchdog_failure_path_shape_unstable_entry():
+    f = watchdog.watch("test.unstable", jax.jit(lambda x: x * 2),
+                       expected=1)
+    f(jnp.ones((2,)))
+    # quiet while within budget
+    assert f.compile_count == 1
+    with pytest.warns(watchdog.RecompileWarning):
+        f(jnp.ones((3,)))                 # second program: warn
+    assert f.compile_count == 2
+    os.environ["PADDLE_TPU_STRICT_COMPILE"] = "1"
+    try:
+        with pytest.raises(watchdog.RecompileError,
+                           match="compile-once violation"):
+            f(jnp.ones((4,)))             # third program: strict raise
+    finally:
+        del os.environ["PADDLE_TPU_STRICT_COMPILE"]
+
+
+def test_watchdog_counts_flow_into_registry_and_report():
+    before = watchdog.compile_counts().get("test.counted", 0)
+    c = obs.counter("compile.count", ("entry",)).labels(
+        entry="test.counted")
+    v0 = c.value
+    f = watchdog.watch("test.counted", jax.jit(lambda x: x + 1))
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))    # same shape: no new program
+    f(jnp.ones((5,)))    # new program (no budget set: counted, no warning)
+    assert watchdog.compile_counts()["test.counted"] == before + 2
+    assert c.value == v0 + 2
+
+
+def test_watchdog_resync_after_registry_reset():
+    """Registry.reset() zeroes the compile.count shadow; resync_counter()
+    must bring it back to the watchdog's ground truth (the cache sizes) so
+    Prometheus/JSONL exports agree with compile_counts() — the bench's
+    post-warmup reset path."""
+    f = watchdog.watch("test.resync", jax.jit(lambda x: x + 1))
+    f(jnp.ones((2,)))
+    f(jnp.ones((3,)))    # two programs
+    leaf = obs.counter("compile.count", ("entry",)).labels(
+        entry="test.resync")
+    assert leaf.value == 2.0
+    obs.default_registry().reset()
+    assert leaf.value == 0.0
+    watchdog.resync_counter()
+    assert leaf.value == watchdog.compile_counts()["test.resync"] == 2
+    # idempotent: a second resync adds nothing
+    watchdog.resync_counter()
+    assert leaf.value == 2.0
+
+
+def test_profiler_without_exporter_strands_no_marks():
+    """Marks exist solely for the trace-export stream: a Profiler with no
+    on_trace_ready must not grow the module-global mark buffer (it would
+    leak for the life of the process with nothing draining it)."""
+    from paddle_tpu import profiler as prof
+
+    obs.counter("serving.generated_tokens").inc()
+    before = len(prof._metric_marks)
+    p = prof.Profiler()          # no on_trace_ready
+    p.start()
+    p.stop()
+    assert len(prof._metric_marks) == before
+
+
+def test_watchdog_entries_are_weakly_held():
+    import gc
+    f = watchdog.watch("test.weak", jax.jit(lambda x: x + 1))
+    f(jnp.ones((2,)))
+    assert watchdog.compile_counts().get("test.weak") == 1
+    del f
+    gc.collect()
+    assert "test.weak" not in watchdog.compile_counts()
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _sample_registry():
+    reg = Registry(catalog=None)
+    reg.counter("requests.total", ("kind",)).labels(kind="ok").inc(3)
+    reg.gauge("depth").set(2)
+    h = reg.histogram("lat.seconds")
+    for v in (0.01, 0.02, 0.04):
+        h.observe(v)
+    return reg
+
+
+def test_prometheus_text_format():
+    text = exporters.to_prometheus(_sample_registry())
+    assert '# TYPE requests_total counter' in text
+    assert 'requests_total{kind="ok"} 3.0' in text
+    assert '# TYPE lat_seconds summary' in text
+    assert 'lat_seconds{quantile="0.50"}' in text
+    assert 'lat_seconds_count 3' in text
+    assert '# TYPE depth gauge' in text
+
+
+def test_jsonl_snapshot_roundtrip(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    exp = exporters.JsonlExporter(str(p))
+    exp.write(_sample_registry())
+    exp.write(_sample_registry())
+    lines = [json.loads(l) for l in p.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["ts"] > 0
+    m = lines[0]["metrics"]
+    assert m["requests.total"]["series"][0]["value"] == 3.0
+    assert m["lat.seconds"]["series"][0]["count"] == 3
+    assert {"p50", "p95", "p99"} <= set(m["lat.seconds"]["series"][0])
+
+
+def test_chrome_trace_export_carries_metric_marks(tmp_path):
+    from paddle_tpu import profiler as prof
+
+    obs.counter("serving.generated_tokens").inc(7)
+    p = prof.Profiler(
+        on_trace_ready=prof.export_chrome_tracing(str(tmp_path)))
+    p.start()
+    with prof.RecordEvent("span_under_metrics"):
+        pass
+    p.stop()
+    doc = json.load(open(p._last_export))
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert counters, "no metric marks in the chrome trace"
+    names = {e["name"] for e in counters}
+    assert any(n.startswith("serving.generated_tokens") for n in names)
+    assert all("value" in e["args"] for e in counters)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _write_snapshots(path):
+    exp = exporters.JsonlExporter(str(path))
+    exp.write(_sample_registry())
+    exp.write(_sample_registry())
+
+
+def test_cli_dump_prom_and_json(tmp_path, capsys):
+    from paddle_tpu.observability.__main__ import main
+
+    p = tmp_path / "m.jsonl"
+    _write_snapshots(p)
+    assert main(["dump", "--file", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert 'requests_total{kind="ok"} 3.0' in out
+    assert main(["dump", "--file", str(p), "--format", "json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out)["requests.total"]["type"] == "counter"
+
+
+def test_cli_dump_missing_file_exits_cleanly(tmp_path, capsys):
+    from paddle_tpu.observability.__main__ import main
+
+    rc = main(["dump", "--file", str(tmp_path / "never_written.jsonl")])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "no snapshots" in err
+
+
+def test_cli_tail_summarizes_lines(tmp_path, capsys):
+    from paddle_tpu.observability.__main__ import main
+
+    p = tmp_path / "m.jsonl"
+    _write_snapshots(p)
+    assert main(["tail", "--file", str(p)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    assert "requests.total{kind=ok}=3" in out[0]
+    assert "lat.seconds: n=3" in out[0]
+
+
+def test_cli_serve_exposes_prometheus(tmp_path):
+    from paddle_tpu.observability.__main__ import make_server
+
+    p = tmp_path / "m.jsonl"
+    _write_snapshots(p)
+    srv = make_server(str(p), port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = "http://127.0.0.1:%d/metrics" % srv.server_address[1]
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'requests_total{kind="ok"} 3.0' in body
+        assert urllib.request.urlopen(
+            "http://127.0.0.1:%d/" % srv.server_address[1],
+            timeout=5).status == 200
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# queue_wait satellite
+# ---------------------------------------------------------------------------
+
+def test_scheduler_splits_queue_wait_out_of_ttft():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    engine = DecodeEngine(GPTForCausalLM(cfg), num_slots=1, max_len=64,
+                          seed=0)
+    sched = ContinuousBatchingScheduler(engine)
+    rng = np.random.default_rng(2)
+    # 3 requests into ONE slot: the 2nd/3rd must QUEUE while the earlier
+    # ones decode, so their queue_wait is necessarily positive
+    for _ in range(3):
+        sched.submit(Request(prompt=rng.integers(0, cfg.vocab_size, (6,)),
+                             max_new_tokens=4, temperature=0.0))
+    results = sched.run()
+    assert len(results) == 3
+    by_rid = [results[r] for r in sorted(results)]
+    for r in by_rid:
+        assert r.queue_wait >= 0.0
+        # TTFT still includes the queue component (documented contract),
+        # so the split piece can never exceed it
+        assert r.ttft >= r.queue_wait
+    assert by_rid[1].queue_wait > 0.0
+    assert by_rid[2].queue_wait > by_rid[1].queue_wait
+
+
+# ---------------------------------------------------------------------------
+# bench schema validator (tools/bench_schema.py)
+# ---------------------------------------------------------------------------
+
+def _bench_schema():
+    import importlib.util
+    import pathlib
+    p = pathlib.Path(__file__).resolve().parent.parent / "tools" \
+        / "bench_schema.py"
+    spec = importlib.util.spec_from_file_location("bench_schema", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_schema_accepts_committed_trajectory_and_new_block():
+    bs = _bench_schema()
+    import glob
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent
+    files = sorted(glob.glob(str(root / "BENCH_*.json")))
+    assert files, "no BENCH_*.json trajectory files found"
+    for f in files:
+        bs.validate_path(f)        # raises on schema violation
+    line = {
+        "metric": "decode_tokens_per_sec", "value": 10.0, "unit": "tok/s",
+        "compile_counts": {"decode": 1, "prefill": 2},
+        "metrics": {
+            "histograms": {"serving.ttft_seconds": {
+                "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0, "count": 5}},
+            "compile_counts": {"serving.decode": 1},
+        },
+    }
+    bs.validate_line(line, "<t>", ["serving.decode"])
+
+
+def test_bench_schema_rejects_malformed_lines():
+    bs = _bench_schema()
+    ok_metrics = {"histograms": {}, "compile_counts": {}}
+    for bad in (
+        {"value": 1.0, "unit": "x"},                      # no metric
+        {"metric": "m", "value": "fast", "unit": "x"},    # value not num
+        {"metric": "m", "value": 1.0, "unit": "x",
+         "compile_counts": {"decode": 0}},                # zero compiles
+        {"metric": "m", "value": 1.0, "unit": "x",
+         "metrics": {"histograms": {"h": {"p50_ms": 3.0, "p95_ms": 2.0,
+                                          "p99_ms": 4.0, "count": 1}},
+                     "compile_counts": {}}},              # unordered pcts
+        {"metric": "m", "value": 1.0, "unit": "x",
+         "metrics": {"histograms": {}}},                  # no compile_counts
+    ):
+        import pytest as _pt
+        with _pt.raises(bs.SchemaError):
+            bs.validate_line(bad, "<t>")
+    # --expect-compile-once fails on a 2-program entry
+    import pytest as _pt
+    with _pt.raises(bs.SchemaError, match="expected exactly 1"):
+        bs.validate_line(
+            {"metric": "m", "value": 1.0, "unit": "x",
+             "metrics": {"histograms": {},
+                         "compile_counts": {"serving.decode": 2}}},
+            "<t>", ["serving.decode"])
+    with _pt.raises(bs.SchemaError, match="rc"):
+        bs.validate_wrapper({"rc": 1, "parsed": ok_metrics}, "<t>")
+
+
+def test_flush_writes_default_registry(tmp_path):
+    obs.counter("serving.generated_tokens").inc()
+    out = obs.flush(str(tmp_path / "snap.jsonl"))
+    doc = json.loads(open(out).read().splitlines()[-1])
+    assert "serving.generated_tokens" in doc["metrics"]

@@ -1,0 +1,173 @@
+"""Dedicated coverage for paddle_tpu/profiler/ (ISSUE 6 satellite): the
+make_scheduler window state machine, RecordEvent span semantics (nesting,
+re-use, threads), chrome-trace JSON export round-trip, the tuple-scheduler
+Profiler path, and the ips timer."""
+import json
+import threading
+import time
+
+from paddle_tpu import profiler as prof
+from paddle_tpu.profiler import ProfilerState as S
+
+
+# ---------------------------------------------------------------------------
+# make_scheduler state machine (reference: profiler.py:67)
+# ---------------------------------------------------------------------------
+
+def test_make_scheduler_basic_window_cycle():
+    sched = prof.make_scheduler(closed=2, ready=1, record=2)
+    # period = 5: [closed, closed, ready, record, record_and_return] repeat
+    expected = [S.CLOSED, S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN]
+    got = [sched(i) for i in range(10)]
+    assert got == expected * 2
+
+
+def test_make_scheduler_skip_first_shifts_the_cycle():
+    sched = prof.make_scheduler(closed=1, ready=1, record=1, skip_first=3)
+    assert [sched(i) for i in range(3)] == [S.CLOSED] * 3
+    assert [sched(i) for i in range(3, 6)] == [S.CLOSED, S.READY,
+                                               S.RECORD_AND_RETURN]
+
+
+def test_make_scheduler_repeat_caps_cycles():
+    sched = prof.make_scheduler(closed=0, ready=1, record=1, repeat=2)
+    # two 2-step cycles run, then closed forever
+    assert sched(0) == S.READY and sched(1) == S.RECORD_AND_RETURN
+    assert sched(2) == S.READY and sched(3) == S.RECORD_AND_RETURN
+    assert all(sched(i) == S.CLOSED for i in range(4, 12))
+
+
+def test_make_scheduler_record_only_cycle():
+    sched = prof.make_scheduler(closed=0, ready=0, record=3)
+    assert [sched(i) for i in range(3)] == [S.RECORD, S.RECORD,
+                                            S.RECORD_AND_RETURN]
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent spans
+# ---------------------------------------------------------------------------
+
+def _drain():
+    return prof._recorder.drain()
+
+
+def test_record_event_records_span_with_duration():
+    _drain()  # isolate from other tests' leftovers
+    with prof.RecordEvent("outer_span"):
+        time.sleep(0.002)
+    events = _drain()
+    assert [e[0] for e in events] == ["outer_span"]
+    name, ts, dur, tid = events[0]
+    assert dur >= 2e6      # perf_counter_ns units: >= 2 ms
+    assert tid == threading.get_ident()
+
+
+def test_record_event_nesting_orders_and_contains():
+    _drain()
+    with prof.RecordEvent("outer"):
+        with prof.RecordEvent("inner"):
+            time.sleep(0.001)
+    events = {e[0]: e for e in _drain()}
+    assert set(events) == {"outer", "inner"}
+    # inner CLOSES first (recorded first) and nests inside outer's window
+    o, i = events["outer"], events["inner"]
+    assert i[1] >= o[1]                      # inner starts after outer
+    assert i[1] + i[2] <= o[1] + o[2] + 1e4  # and ends within it (10us slop)
+    assert o[2] >= i[2]
+
+
+def test_record_event_end_without_begin_is_noop_and_reusable():
+    _drain()
+    ev = prof.RecordEvent("again")
+    ev.end()                 # never begun: must not record
+    assert _drain() == []
+    for _ in range(2):       # one object, two spans
+        ev.begin()
+        ev.end()
+    assert [e[0] for e in _drain()] == ["again", "again"]
+
+
+def test_record_event_threads_carry_distinct_tids():
+    _drain()
+
+    # a barrier keeps all three alive together: thread idents are reused
+    # after exit, so sequential short-lived threads could share one
+    barrier = threading.Barrier(3)
+
+    def work():
+        with prof.RecordEvent("threaded"):
+            barrier.wait(timeout=10)
+
+    ts = [threading.Thread(target=work) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    events = _drain()
+    assert len(events) == 3
+    assert len({e[3] for e in events}) == 3
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export round-trip
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_roundtrip(tmp_path):
+    p = prof.Profiler(
+        on_trace_ready=prof.export_chrome_tracing(str(tmp_path),
+                                                  worker_name="w0"))
+    p.start()
+    with prof.RecordEvent("step_compute"):
+        time.sleep(0.001)
+    with prof.RecordEvent("h2d_copy"):
+        pass
+    p.stop()
+    assert p._last_export and "w0_" in p._last_export
+    doc = prof.load_profiler_result(p._last_export)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"step_compute", "h2d_copy"} <= names
+    for e in spans:
+        assert e["cat"] == "host"
+        assert e["dur"] >= 0            # exported in MICROseconds
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    step = next(e for e in spans if e["name"] == "step_compute")
+    assert step["dur"] >= 1000          # the 1ms sleep, in us
+
+
+def test_profiler_tuple_scheduler_exports_on_window_close(tmp_path):
+    # scheduler=(1, 3): skip step 1, record steps 2..3, export at 3
+    p = prof.Profiler(
+        scheduler=(1, 3),
+        on_trace_ready=prof.export_chrome_tracing(str(tmp_path)))
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("win_step"):
+            pass
+        p.step()
+    p.stop()
+    doc = prof.load_profiler_result(p._last_export)
+    assert any(e["name"] == "win_step" for e in doc["traceEvents"])
+
+
+def test_profiler_summary_aggregates_by_name():
+    p = prof.Profiler()
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("agg_span"):
+            pass
+    p.stop()
+    table = p.summary()
+    line = next(l for l in table.splitlines() if "agg_span" in l)
+    assert " 3 " in " ".join(line.split())
+
+
+def test_timer_hub_step_info_reports_ips():
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        time.sleep(0.001)
+        p.step(num_samples=8)
+    info = p.step_info()
+    assert "avg_step_time" in info and "ips" in info
+    p.stop()

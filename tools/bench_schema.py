@@ -1,0 +1,199 @@
+"""Schema validator for the BENCH_* trajectory (ISSUE 6 satellite).
+
+Two input shapes:
+
+* **Wrapper files** (``BENCH_r05.json`` etc., written by the bench
+  driver): ``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed`` is
+  the bench's JSON line.
+* **Raw lines** (``--line -`` reads stdin, or ``--line '<json>'``): the
+  JSON line a bench prints — what the CI bench-smoke pipes in.
+
+The line schema is the contract bench.py / bench_decode.py print:
+required ``metric``/``value``/``unit``; optional ``compile_counts`` (a
+{entry: count>=1} int map) and the ISSUE-6 ``metrics`` block::
+
+    "metrics": {
+      "histograms": {"<name>": {"p50_ms", "p95_ms", "p99_ms", "count"}},
+      "compile_counts": {"<watchdog entry>": int}
+    }
+
+Old trajectory files (pre-metrics-block, BENCH_r01..r05) validate clean:
+the block is optional, but WHEN present it must be well-formed
+(percentiles ordered p50<=p95<=p99, non-negative counts).
+
+``--expect-compile-once ENTRY`` additionally requires the watchdog's
+count for ENTRY to be exactly 1 — the CI smoke gate that replaced
+bench_decode's ad-hoc assert (the watchdog also enforces it at runtime
+under PADDLE_TPU_STRICT_COMPILE=1; this checks the *reported* line).
+
+Exit 0 = every input valid.  No third-party deps (hand-rolled checks:
+the CI image has no jsonschema).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from typing import Any, List
+
+
+class SchemaError(Exception):
+    pass
+
+
+def _require(cond: bool, path: str, msg: str):
+    if not cond:
+        raise SchemaError("%s: %s" % (path, msg))
+
+
+def _is_num(x: Any) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def validate_histogram_block(name: str, h: Any, path: str):
+    _require(isinstance(h, dict), path, "histogram %r must be an object"
+             % name)
+    for k in ("p50_ms", "p95_ms", "p99_ms", "count"):
+        _require(k in h, path, "histogram %r missing %r" % (name, k))
+        _require(_is_num(h[k]), path, "histogram %r field %r must be a "
+                 "number, got %r" % (name, k, type(h[k]).__name__))
+        _require(h[k] >= 0, path, "histogram %r field %r is negative"
+                 % (name, k))
+    _require(h["p50_ms"] <= h["p95_ms"] <= h["p99_ms"], path,
+             "histogram %r percentiles are not ordered "
+             "(p50<=p95<=p99): %r" % (name, h))
+    _require(isinstance(h["count"], int), path,
+             "histogram %r count must be an int" % name)
+
+
+def validate_compile_counts(cc: Any, path: str, where: str):
+    _require(isinstance(cc, dict), path, "%s must be an object" % where)
+    for entry, count in cc.items():
+        _require(isinstance(entry, str) and entry, path,
+                 "%s keys must be non-empty strings" % where)
+        _require(isinstance(count, int) and not isinstance(count, bool),
+                 path, "%s[%r] must be an int, got %r"
+                 % (where, entry, count))
+        _require(count >= 1, path,
+                 "%s[%r] = %d — a reported entry must have compiled at "
+                 "least once" % (where, entry, count))
+
+
+def validate_line(doc: Any, path: str,
+                  expect_compile_once: List[str] = ()):
+    _require(isinstance(doc, dict), path, "bench line must be a JSON object")
+    for k, t in (("metric", str), ("unit", str)):
+        _require(isinstance(doc.get(k), t), path,
+                 "%r must be a %s, got %r" % (k, t.__name__, doc.get(k)))
+    _require(_is_num(doc.get("value")), path, "'value' must be a number")
+    if "vs_baseline" in doc:
+        _require(_is_num(doc["vs_baseline"]), path,
+                 "'vs_baseline' must be a number")
+    if "compile_counts" in doc:
+        validate_compile_counts(doc["compile_counts"], path,
+                                "compile_counts")
+    if "metrics" in doc:
+        m = doc["metrics"]
+        _require(isinstance(m, dict), path, "'metrics' must be an object")
+        _require("histograms" in m, path,
+                 "metrics block missing 'histograms'")
+        _require(isinstance(m["histograms"], dict), path,
+                 "metrics.histograms must be an object")
+        for name, h in m["histograms"].items():
+            validate_histogram_block(name, h, path)
+        _require("compile_counts" in m, path,
+                 "metrics block missing 'compile_counts' (the watchdog "
+                 "report)")
+        validate_compile_counts(m["compile_counts"], path,
+                                "metrics.compile_counts")
+    for entry in expect_compile_once:
+        _require("metrics" in doc, path,
+                 "--expect-compile-once needs the metrics block")
+        got = doc["metrics"]["compile_counts"].get(entry)
+        _require(got == 1, path,
+                 "watchdog reports compile_counts[%r] = %r, expected "
+                 "exactly 1 (compile-once contract)" % (entry, got))
+
+
+def validate_wrapper(doc: Any, path: str,
+                     expect_compile_once: List[str] = ()):
+    _require(isinstance(doc, dict), path, "wrapper must be a JSON object")
+    _require("parsed" in doc or "tail" in doc, path,
+             "wrapper has neither 'parsed' nor 'tail'")
+    if "rc" in doc:
+        _require(doc["rc"] == 0, path,
+                 "bench exited rc=%r — a failed run must not enter the "
+                 "trajectory" % (doc["rc"],))
+    parsed = doc.get("parsed")
+    if parsed is None:
+        # driver could not parse a line: last resort, find one in tail
+        for raw in reversed(doc.get("tail", "").splitlines()):
+            raw = raw.strip()
+            if raw.startswith("{"):
+                parsed = json.loads(raw)
+                break
+        _require(parsed is not None, path,
+                 "no JSON line found in wrapper 'tail'")
+    validate_line(parsed, path + ":parsed", expect_compile_once)
+
+
+def validate_path(path: str, expect_compile_once: List[str] = ()):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and ("parsed" in doc or "cmd" in doc
+                                  or "tail" in doc):
+        validate_wrapper(doc, path, expect_compile_once)
+    else:
+        validate_line(doc, path, expect_compile_once)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/bench_schema.py",
+        description="validate BENCH_* trajectory files / bench JSON lines")
+    ap.add_argument("paths", nargs="*",
+                    help="files to validate (default: BENCH_*.json)")
+    ap.add_argument("--line", default=None,
+                    help="validate ONE raw bench line: a JSON string, or "
+                         "'-' to read it from stdin (last non-empty line)")
+    ap.add_argument("--expect-compile-once", action="append", default=[],
+                    metavar="ENTRY",
+                    help="require metrics.compile_counts[ENTRY] == 1")
+    args = ap.parse_args(argv)
+
+    failures = []
+    try:
+        if args.line is not None:
+            raw = args.line
+            if raw == "-":
+                lines = [l for l in sys.stdin.read().splitlines()
+                         if l.strip()]
+                if not lines:
+                    raise SchemaError("<stdin>: no input line")
+                raw = lines[-1]
+            validate_line(json.loads(raw), "<line>",
+                          args.expect_compile_once)
+            print("ok: <line>")
+    except SchemaError as e:
+        failures.append(str(e))
+
+    paths = args.paths or (sorted(glob.glob("BENCH_*.json"))
+                           if args.line is None else [])
+    for p in paths:
+        try:
+            validate_path(p, args.expect_compile_once)
+            print("ok: %s" % p)
+        except (SchemaError, json.JSONDecodeError, OSError) as e:
+            failures.append("%s: %s" % (p, e) if not isinstance(
+                e, SchemaError) else str(e))
+
+    if failures:
+        for f in failures:
+            print("SCHEMA ERROR — %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
