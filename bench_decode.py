@@ -1,23 +1,34 @@
-"""Benchmark: serving decode throughput through the slotted-cache engine.
+"""Benchmark: serving decode throughput through the compiled engine.
 
 Prints ONE JSON line (the BENCH_decode_* trajectory format, next to the
 training one from bench.py):
 
   {"metric": "decode_tokens_per_sec", "value": N, "unit": "tok/s",
-   "ttft_ms": ..., "tpot_ms": ..., "compile_counts": {...}, ...}
+   "ttft_ms": ..., "tpot_ms": ..., "kv_bytes_per_token": {...},
+   "compile_counts": {...}, ...}
 
 Protocol: submit `requests` prompts through the continuous-batching
 scheduler at `num_slots` concurrency and time the full drain.  Decode
 throughput counts every generated token (first tokens, which are
 prefill work, are reported separately via TTFT).  `compile_counts`
 asserts the structural claim this engine exists for: the decode step
-compiles EXACTLY ONCE no matter how many tokens are generated or how
-slots churn — enforced by the recompile watchdog
+compiles EXACTLY ONCE no matter how many tokens are generated, how
+slots churn, how many admissions hit the prefix cache, or how many
+chunked prefills interleave — enforced by the recompile watchdog
 (paddle_tpu.observability.watchdog), which this bench arms in STRICT
 mode so any retrace raises at the step that caused it instead of being
 discovered in a summary line.  The `metrics` block carries p50/p95/p99
 TTFT/TPOT/queue-wait from the histogram registry (reset after warmup so
 percentiles describe the timed drain only).
+
+Cache layout (ISSUE 7): `--paged` (the default) runs the page-pool
+engine — chunked prefill, prefix sharing, paged-gather attention — and
+reports `kv_bytes_per_token`, the measured A/B of the decode KV read
+bound: `paged` is what a length-aware paged schedule reads (each slot's
+MAPPED pages), `flat` is the slotted `slots*max_len` bound.  A third of
+the workload reuses one shared prompt so the prefix cache actually
+exercises (`prefix_hit_pages` in the line).  `--slotted` runs the PR-5
+layout for the A/B baseline; `--both` emits two JSON lines.
 
 On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
 On CPU: the tiny config, so the bench always runs (numbers are smoke
@@ -27,16 +38,13 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
 
-def main():
-    # the watchdog IS the compile-count gate: any recompile of a watched
-    # entry (serving.decode budget: 1) raises RecompileError mid-drain
-    os.environ.setdefault("PADDLE_TPU_STRICT_COMPILE", "1")
-
+def run_layout(paged: bool):
     import jax
 
     import paddle_tpu as paddle
@@ -51,11 +59,11 @@ def main():
     if on_tpu:
         cfg = GPTConfig.gpt2_medium()
         num_slots, prompt_len, max_new, requests = 8, 128, 128, 24
-        max_len = 1024
+        max_len, page_size = 1024, 64
     else:  # CPU smoke config so bench_decode.py always runs
         cfg = GPTConfig.tiny()
         num_slots, prompt_len, max_new, requests = 4, 12, 16, 8
-        max_len = 128
+        max_len, page_size = 128, 16
     num_slots = int(os.getenv("PADDLE_TPU_BENCH_SLOTS", num_slots))
     prompt_len = int(os.getenv("PADDLE_TPU_BENCH_PROMPT", prompt_len))
     max_new = int(os.getenv("PADDLE_TPU_BENCH_NEW", max_new))
@@ -69,22 +77,36 @@ def main():
     model.eval()
 
     engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
-                          seed=0)
+                          seed=0, paged=paged, page_size=page_size)
     rng = np.random.default_rng(0)
+    # one shared "system prompt" a third of the requests reuse — the
+    # prefix-sharing path must be ON the timed path, not a dead feature
+    shared_prompt = rng.integers(0, cfg.vocab_size, (prompt_len,))
 
     def drive(n_requests):
         sched = ContinuousBatchingScheduler(engine)
-        for _ in range(n_requests):
-            sched.submit(Request(
-                prompt=rng.integers(0, cfg.vocab_size, (prompt_len,)),
-                max_new_tokens=max_new, temperature=0.0))
+        for i in range(n_requests):
+            prompt = (shared_prompt if paged and i % 3 == 0
+                      else rng.integers(0, cfg.vocab_size, (prompt_len,)))
+            # request 0 outlives its admission wave by one page of
+            # tokens: a later wave's shared-prompt admission then maps
+            # its LIVE tail page (refcount 2) and the capped final-token
+            # chunk write must copy-on-write first — keeps
+            # serving.cow_copy on the benched path (same-wave sharers
+            # miss each other: registration happens at prefill END, and
+            # a retired sharer's cached page comes back at refcount 1)
+            extra = page_size if (paged and i == 0) else 0
+            sched.submit(Request(prompt=prompt,
+                                 max_new_tokens=max_new + extra,
+                                 temperature=0.0))
         t0 = time.perf_counter()
         results = sched.run()
         return results, time.perf_counter() - t0
 
-    # warmup drain: compiles prefill (one bucket) + the decode step once
+    # warmup drain: compiles prefill (one chunk program / one bucket) +
+    # the decode step once
     drive(min(num_slots, requests))
-    engine.reset()
+    engine.reset()      # pages/slots back + kv_stats re-zeroed
     # percentiles must describe the TIMED drain, not the compile-heavy
     # warmup — drop warmup samples.  reset() also zeroes the registry's
     # compile.count shadow of the watchdog (whose ground truth, the jit
@@ -99,6 +121,7 @@ def main():
     ttft_ms = 1e3 * float(np.mean([r.ttft for r in results.values()]))
     tpot_ms = 1e3 * float(np.mean(
         [r.tpot for r in results.values() if r.tokens.size > 1]))
+    prefix_hit_tokens = sum(r.prefix_hit_tokens for r in results.values())
 
     def _pcts(name):
         h = obs.histogram(name)
@@ -107,6 +130,7 @@ def main():
                 "p99_ms": round(1e3 * h.percentile(0.99), 3),
                 "count": h.count}
 
+    kv = engine.kv_bytes_per_token()
     from paddle_tpu.kernels import autotune as at
     result = {
         "metric": "decode_tokens_per_sec",
@@ -116,9 +140,16 @@ def main():
         "tpot_ms": round(tpot_ms, 3),
         "total_tokens": total_tokens,
         "wall_s": round(dt, 3),
+        "cache_layout": "paged" if paged else "slotted",
+        # the ISSUE-7 acceptance line: decode KV bytes read per
+        # generated token — `paged` scales with TRUE lengths (mapped
+        # pages), `flat` is the slotted slots*max_len bound the paged
+        # layout replaces
+        "kv_bytes_per_token": {k: round(v, 1) for k, v in kv.items()},
+        "prefix_hit_tokens": prefix_hit_tokens,
         # compile accounting now comes from the recompile watchdog (which
-        # also enforces the budget at runtime — strict mode above); the
-        # engine properties remain as a cross-check
+        # also enforces the budget at runtime — strict mode); the engine
+        # properties remain as a cross-check
         "compile_counts": {
             "decode": engine.decode_compile_count,
             "prefill": engine.prefill_compile_count,
@@ -140,10 +171,31 @@ def main():
             "num_slots": num_slots, "max_len": max_len,
             "prompt_len": prompt_len, "max_new_tokens": max_new,
             "requests": requests,
+            **({"page_size": engine.page_size,
+                "num_pages": engine.num_pages,
+                "prefill_chunk": engine.prefill_chunk} if paged else {}),
         },
         "autotune": at.report(),
     }
     print(json.dumps(result))
+    sys.stdout.flush()
+
+
+def main(argv=None):
+    # the watchdog IS the compile-count gate: any recompile of a watched
+    # entry (serving.decode budget: 1) raises RecompileError mid-drain
+    os.environ.setdefault("PADDLE_TPU_STRICT_COMPILE", "1")
+    argv = sys.argv[1:] if argv is None else argv
+    if "--both" in argv:
+        layouts = [True, False]
+    elif "--slotted" in argv:
+        layouts = [False]
+    else:                          # --paged is the default
+        layouts = [True]
+    for paged in layouts:
+        # run_layout resets the registry and resyncs the watchdog after
+        # its own warmup drain, so no inter-layout state scrub is needed
+        run_layout(paged)
 
 
 if __name__ == "__main__":

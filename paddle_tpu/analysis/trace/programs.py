@@ -11,9 +11,11 @@ registry pins the repo's compiled entry points the way
   ('pp',) mesh (``paddle_tpu.distributed.pipeline.canonical_1f1b_step``);
 * ``gpt_decode`` — the model-level one-token decode step over the STATIC
   slotted KV cache (prefill eagerly, trace the cached decode);
-* ``serving/decode_step`` / ``serving/prefill`` — the serving engine's
-  batched continuous-batching iteration (cache buffers donated — TPU502
-  checks the aliasing materializes) and its bucketed prefill;
+* ``serving/*`` — the serving engine's compiled entries for BOTH cache
+  layouts: the paged decode step, chunked prefill, and page
+  copy-on-write (pool buffers donated — TPU502 checks the aliasing
+  materializes) plus the slotted decode step and bucketed prefill kept
+  for A/B;
 * ``pallas/<family>/<variant>`` — every registered Pallas kernel variant,
   traced at the bench-standard key in bf16 (``bf16_region`` metadata set,
   so TPU501 audits the variants' f32 usage against F32_ACCUM_OPS).
@@ -193,12 +195,19 @@ def _build_gpt_decode() -> List[TraceProgram]:
 
 @register_builder("serving", prefix="serving/")
 def _build_serving() -> List[TraceProgram]:
-    """The serving engine's two compiled entry points at a tiny config:
-    ``serving/decode_step`` (the batched, donation-aliased continuous-
-    batching iteration — TPU502 verifies the KV-cache donation actually
-    materializes as input/output aliasing) and ``serving/prefill`` (the
-    smallest bucket)."""
+    """The serving engine's compiled entry points at a tiny config, BOTH
+    cache layouts:
+
+    * paged (the default) — ``serving/decode_step`` (the batched,
+      donation-aliased continuous-batching iteration over the page
+      pool; TPU502 verifies the pool donation actually materializes as
+      input/output aliasing), ``serving/prefill_chunk`` (the single
+      chunked-prefill program) and ``serving/cow_copy`` (the page
+      copy-on-write step, both pool buffers donated);
+    * slotted (kept for A/B) — ``serving/decode_step_slotted`` and
+      ``serving/prefill`` (the smallest bucket)."""
     import jax
+    import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
@@ -206,14 +215,24 @@ def _build_serving() -> List[TraceProgram]:
 
     paddle.seed(0)
     model = GPTForCausalLM(GPTConfig.tiny())
-    engine = DecodeEngine(model, num_slots=2, max_len=64)
+    paged = DecodeEngine(model, num_slots=2, max_len=64, page_size=16)
+    slotted = DecodeEngine(model, num_slots=2, max_len=64, paged=False)
+    cow_args = (paged.cache.k, paged.cache.v, jnp.zeros((), jnp.int32),
+                jnp.ones((), jnp.int32))
     out: List[TraceProgram] = []
     for name, fn, donate, args in (
-            ("serving/decode_step", engine._decode_fn,
-             engine._decode_donate_argnums, engine.decode_trace_args()),
-            ("serving/prefill", engine._prefill_fn,
-             engine._prefill_donate_argnums,
-             engine.prefill_trace_args())):
+            ("serving/decode_step", paged._decode_fn,
+             paged._decode_donate_argnums, paged.decode_trace_args()),
+            ("serving/prefill_chunk", paged._prefill_chunk_fn,
+             paged._prefill_chunk_donate_argnums,
+             paged.prefill_chunk_trace_args()),
+            ("serving/cow_copy", paged._cow_fn,
+             paged._cow_donate_argnums, cow_args),
+            ("serving/decode_step_slotted", slotted._decode_fn,
+             slotted._decode_donate_argnums, slotted.decode_trace_args()),
+            ("serving/prefill", slotted._prefill_fn,
+             slotted._prefill_donate_argnums,
+             slotted.prefill_trace_args())):
         # keep_unused=True for the AUDIT wrap only (same rationale as the
         # train step): pruning would misalign the entry's argument
         # indices against the jaxpr's donation flags.  x64_scope(False)

@@ -97,8 +97,12 @@ def _annotate_op_error(e: BaseException, name, arrays):
             for a in arrays[:6])
         if len(arrays) > 6:
             shapes += f", +{len(arrays) - 6} more"
-        e.add_note(f"[paddle_tpu] operator: {name or '<unnamed>'} "
-                   f"(inputs: {shapes})")
+        note = (f"[paddle_tpu] operator: {name or '<unnamed>'} "
+                f"(inputs: {shapes})")
+        if hasattr(e, "add_note"):
+            e.add_note(note)
+        else:   # python < 3.11: emulate PEP 678 (__notes__ list)
+            e.__notes__ = list(getattr(e, "__notes__", [])) + [note]
     except Exception:
         pass  # never mask the original error
 

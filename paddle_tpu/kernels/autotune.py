@@ -497,6 +497,11 @@ def standard_keys() -> List[tuple]:
     # shape (8 slots, 1024-token cache, GPT-2 345M heads)
     out.append(("decode_attn", dat.autotune_key(
         slots=8, t=1024, h=16, d=64, qlen=1, dtype=dtype)))
+    # the paged layout at the same serving shape: 64-token pages, 16
+    # pages per slot, pool sized for all 8 slots at full depth
+    out.append(("decode_attn_paged", dat.paged_autotune_key(
+        slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
+        qlen=1, dtype=dtype)))
     return out
 
 

@@ -1,26 +1,37 @@
-"""Decode attention — length-masked attention over the slotted KV cache.
+"""Decode attention — length-masked attention over the serving caches.
 
 The serving decode step attends ``q: (slots, s, heads, d)`` (``s`` is 1
-for plain decode) against the full static cache ``k/v: (slots, max_len,
-heads, d)`` with each slot masked to its valid prefix: query offset ``j``
-of a slot with pre-append length ``n`` attends keys ``t <= n + j``.
+for plain decode) against the KV cache with each slot masked to its
+valid prefix: query offset ``j`` of a slot with pre-append length ``n``
+attends keys ``t <= n + j``.  Two cache layouts, two autotune families:
 
-Registered as the ``decode_attn`` autotune family so the variant choice
-can be tuned on-chip next TPU session (PERF.md protocol).  Variants are
+* ``decode_attn`` — the slotted contiguous cache ``k/v: (slots,
+  max_len, heads, d)``.
+* ``decode_attn_paged`` — the paged pool ``k/v: (num_pages, page_size,
+  heads, d)`` plus a per-slot ``page_table: (slots, max_pages)`` int32
+  (one layer's slice of ``serving.cache.PagedKVCache``): keys are
+  *gathered* through the table, so each slot reads its own mapped pages
+  (shared prefix pages included) and the read bound a page-aware
+  schedule pays scales with mapped pages, not ``max_len``.
+
+Both are registered with the autotuner so the variant choice can be
+tuned on-chip next TPU session (PERF.md protocol).  Variants are
 XLA-level (no Pallas) — at decode shapes the op is bandwidth-bound on
 the K/V read, which XLA already streams well; what is worth tuning is
 the *schedule*:
 
-* ``masked`` (default) — one-shot: full ``(slots, h, s, max_len)``
-  masked logits, f32 softmax statistics.  Minimal launches; peak memory
-  O(slots*h*s*max_len) f32.
-* ``chunked`` — online-softmax streamed over ``block_t``-sized key
-  chunks (the flash recurrence along the time axis): O(block_t) logits
-  working set, and chunks wholly past every slot's valid prefix still
-  compute but contribute zeros.  Candidate win at long ``max_len`` where
-  the one-shot logits buffer stops fitting close to the compute.
+* ``masked`` / ``paged_gather`` (defaults) — one-shot: (gather then)
+  full ``(slots, h, s, T)`` masked logits, f32 softmax statistics.
+  Minimal launches; peak memory O(slots*h*s*T) f32 plus, for the paged
+  gather, the materialized ``(slots, max_pages*page_size, h, d)`` keys.
+* ``chunked`` / ``paged_chunked`` — online-softmax streamed over key
+  chunks (the flash recurrence along the time axis); the paged form
+  gathers ``pages_per_block`` pages per scan step, so the gathered
+  working set is O(block) instead of O(max_len).  Candidate win at long
+  ``max_len`` where the one-shot buffers stop fitting close to the
+  compute.
 
-Both variants keep the bf16-region dtype discipline TPU501 audits:
+All variants keep the bf16-region dtype discipline TPU501 audits:
 ``dot_general`` runs on the input dtype with ``preferred_element_type``
 f32 accumulation, the softmax statistic chain stays f32, and ``p`` is
 cast back to the input dtype before the second matmul.
@@ -32,7 +43,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["decode_attention", "autotune_key", "supported_block_ts"]
+__all__ = ["decode_attention", "paged_decode_attention", "autotune_key",
+           "paged_autotune_key", "supported_block_ts",
+           "supported_pages_per_block"]
 
 _NEG_INF = -1e30
 
@@ -67,6 +80,41 @@ def _masked(q, k, v, pos, scale):
     return out.astype(q.dtype)
 
 
+def _online_step(carry, q, k_blk, v_blk, t_ids, q_pos, sc):
+    """One flash-recurrence step over a key block: f32 statistics carry
+    ``(m, l, acc)``; ``t_ids: (block,)`` are the block's global key
+    positions, masked against ``q_pos: (b, s)``."""
+    m, l, acc = carry
+    logits = jnp.einsum("bqhd,bthd->bhqt", q, k_blk,
+                        preferred_element_type=jnp.float32) * sc
+    valid = t_ids[None, None, None, :] <= q_pos[:, None, :, None]
+    logits = jnp.where(valid, logits, jnp.asarray(_NEG_INF, jnp.float32))
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # m_new can stay -inf-ish for rows with no valid key yet; the
+    # exp of (NEG_INF - NEG_INF) = exp(0) rows are zeroed by `valid`
+    p = jnp.exp(logits - m_new[..., None])
+    p = jnp.where(valid, p, jnp.zeros((), jnp.float32))
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqt,bthd->bhqd", p.astype(q.dtype), v_blk,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * corr[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def _online_init(b, h, s, d):
+    return (jnp.full((b, h, s), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s), jnp.float32),
+            jnp.zeros((b, h, s, d), jnp.float32))
+
+
+def _online_finish(carry, q_dtype):
+    m, l, acc = carry
+    out = acc / jnp.maximum(l, jnp.asarray(1e-30, jnp.float32))[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(q_dtype)  # (B,H,s,D)->(B,s,H,D)
+
+
 def _chunked(q, k, v, pos, scale, block_t):
     """Online-softmax over key chunks (flash recurrence along time)."""
     b, s, h, d = q.shape
@@ -81,34 +129,14 @@ def _chunked(q, k, v, pos, scale, block_t):
     vc = jnp.moveaxis(vc, 1, 0)
 
     def body(carry, xs):
-        m, l, acc = carry
         k_blk, v_blk, c = xs
-        logits = jnp.einsum("bqhd,bthd->bhqt", q, k_blk,
-                            preferred_element_type=jnp.float32) * sc
         t_ids = c * block_t + jnp.arange(block_t, dtype=jnp.int32)
-        valid = t_ids[None, None, None, :] <= q_pos[:, None, :, None]
-        logits = jnp.where(valid, logits,
-                           jnp.asarray(_NEG_INF, jnp.float32))
-        m_blk = jnp.max(logits, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        # m_new can stay -inf-ish for rows with no valid key yet; the
-        # exp of (NEG_INF - NEG_INF) = exp(0) rows are zeroed by `valid`
-        p = jnp.exp(logits - m_new[..., None])
-        p = jnp.where(valid, p, jnp.zeros((), jnp.float32))
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqt,bthd->bhqd", p.astype(q.dtype), v_blk,
-                        preferred_element_type=jnp.float32)
-        acc_new = acc * corr[..., None] + pv
-        return (m_new, l_new, acc_new), None
+        return _online_step(carry, q, k_blk, v_blk, t_ids, q_pos, sc), None
 
-    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    a0 = jnp.zeros((b, h, s, d), jnp.float32)
     chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
-    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), (kc, vc, chunk_ids))
-    out = acc / jnp.maximum(l, jnp.asarray(1e-30, jnp.float32))[..., None]
-    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # (B,H,s,D)->(B,s,H,D)
+    carry, _ = jax.lax.scan(body, _online_init(b, h, s, d),
+                            (kc, vc, chunk_ids))
+    return _online_finish(carry, q.dtype)
 
 
 def supported_block_ts(t):
@@ -144,6 +172,104 @@ def decode_attention(q, k, v, lengths, scale=None):
                        q.shape[1], q.dtype)
     cand = at.resolve("decode_attn", key)
     return _dispatch(cand, q, k, v, lengths, scale)
+
+
+# ---------------------------------------------------------------------------
+# paged variants (the decode_attn_paged family)
+# ---------------------------------------------------------------------------
+
+
+def paged_autotune_key(slots, pages, page_size, max_pages, h, d, qlen,
+                       dtype):
+    from . import autotune as at
+    return {"slots": int(slots), "pages": int(pages),
+            "page_size": int(page_size), "max_pages": int(max_pages),
+            "h": int(h), "d": int(d), "qlen": int(qlen),
+            "dtype": str(jnp.dtype(dtype)), "platform": at.platform()}
+
+
+def _gather_pages(kp, table):
+    """kp: (num_pages, P, h, d); table: (B, n) int32 -> (B, n*P, h, d).
+    Unmapped entries hold 0: page 0's bytes are gathered and discarded
+    by the length mask downstream."""
+    b, n = table.shape
+    P, h, d = kp.shape[1], kp.shape[2], kp.shape[3]
+    return kp[table].reshape(b, n * P, h, d)
+
+
+def _paged_gather(q, kp, vp, table, pos, scale):
+    """One-shot: gather every mapped page, then the masked softmax."""
+    return _masked(q, _gather_pages(kp, table), _gather_pages(vp, table),
+                   pos, scale)
+
+
+def _paged_chunked(q, kp, vp, table, pos, scale, pages_per_block):
+    """Online-softmax over page blocks: each scan step gathers
+    ``pages_per_block`` pages per slot and folds them into the flash
+    recurrence — O(block) gathered working set instead of the one-shot
+    ``max_pages * page_size`` materialization."""
+    b, s, h, d = q.shape
+    P = int(kp.shape[1])
+    max_pages = int(table.shape[1])
+    m = int(pages_per_block)
+    n_chunks = max_pages // m
+    block = m * P
+    sc = _scale(scale, d)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    tb = jnp.moveaxis(table.reshape(b, n_chunks, m), 1, 0)  # (C, b, m)
+
+    def body(carry, xs):
+        ids, c = xs
+        k_blk = _gather_pages(kp, ids)
+        v_blk = _gather_pages(vp, ids)
+        t_ids = c * block + jnp.arange(block, dtype=jnp.int32)
+        return _online_step(carry, q, k_blk, v_blk, t_ids, q_pos, sc), None
+
+    chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+    carry, _ = jax.lax.scan(body, _online_init(b, h, s, d),
+                            (tb, chunk_ids))
+    return _online_finish(carry, q.dtype)
+
+
+def supported_pages_per_block(max_pages):
+    return [m for m in (1, 2, 4, 8) if max_pages % m == 0 and m < max_pages]
+
+
+def _paged_candidates(key):
+    out = [{"variant": "paged_gather", "config": {}}]
+    for m in supported_pages_per_block(key["max_pages"]):
+        out.append({"variant": "paged_chunked",
+                    "config": {"pages_per_block": m}})
+    return out
+
+
+def _dispatch_paged(cand, q, kp, vp, table, pos, scale):
+    if cand.get("variant") == "paged_chunked":
+        m = int(cand.get("config", {}).get("pages_per_block", 0))
+        if m > 0 and table.shape[1] % m == 0:
+            return _paged_chunked(q, kp, vp, table, pos, scale, m)
+        # invalid cached/pinned config for this key: fall back, never fault
+    return _paged_gather(q, kp, vp, table, pos, scale)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None):
+    """Length-masked attention over one layer's page pool (raw arrays).
+
+    q: (slots, s, heads, d); k_pages/v_pages: (num_pages, page_size,
+    heads, d); page_table: (slots, max_pages) int32; lengths: (slots,)
+    int32 — each slot's PRE-append valid length (the new rows were
+    already scattered into the mapped pages, so query offset j attends
+    keys t <= lengths + j; unmapped entries gather page 0 and are
+    masked).
+    """
+    from . import autotune as at
+    key = paged_autotune_key(q.shape[0], k_pages.shape[0],
+                             k_pages.shape[1], page_table.shape[1],
+                             q.shape[2], q.shape[3], q.shape[1], q.dtype)
+    cand = at.resolve("decode_attn_paged", key)
+    return _dispatch_paged(cand, q, k_pages, v_pages, page_table, lengths,
+                           scale)
 
 
 # ---------------------------------------------------------------------------
@@ -201,10 +327,65 @@ def _traceable(cand, key):
     return functools.partial(_dispatch, cand, scale=None), (q, k, v, pos)
 
 
+def _paged_operands(key):
+    from ..core.dtype import x64_scope
+    ks = tuple(sorted(key.items()))
+    ops = _RUNNER_OPERANDS.get(ks)
+    if ops is None:
+        with x64_scope(False):
+            rng = jax.random.key(0)
+            kq, kk, kv = jax.random.split(rng, 3)
+            dt = jnp.dtype(key["dtype"])
+            b, n_pages, P, mp, h, d, s = (
+                key["slots"], key["pages"], key["page_size"],
+                key["max_pages"], key["h"], key["d"], key["qlen"])
+            q = jax.random.normal(kq, (b, s, h, d), dt)
+            kp = jax.random.normal(kk, (n_pages, P, h, d), dt)
+            vp = jax.random.normal(kv, (n_pages, P, h, d), dt)
+            # representative mapping: round-robin over the pool, slots at
+            # staggered fill depths (like the slotted runner's pos)
+            table = (jnp.arange(b * mp, dtype=jnp.int32).reshape(b, mp)
+                     % jnp.asarray(n_pages, jnp.int32))
+            t = mp * P
+            pos = (jnp.arange(b, dtype=jnp.int32) * (t // max(b, 1))
+                   % jnp.asarray(max(t - s, 1), jnp.int32))
+        ops = _RUNNER_OPERANDS[ks] = (q, kp, vp, table, pos)
+    return ops
+
+
+def _paged_runner(cand, key):
+    from ..core.dtype import x64_scope
+    q, kp, vp, table, pos = _paged_operands(key)
+    with x64_scope(False):
+        fn = jax.jit(functools.partial(_dispatch_paged, cand, scale=None))
+        fn(q, kp, vp, table, pos).block_until_ready()  # compile untimed
+
+    def run():
+        jax.block_until_ready(fn(q, kp, vp, table, pos))
+    return run
+
+
+def _paged_traceable(cand, key):
+    dt = jnp.dtype(key["dtype"])
+    b, n_pages, P, mp, h, d, s = (
+        key["slots"], key["pages"], key["page_size"], key["max_pages"],
+        key["h"], key["d"], key["qlen"])
+    q = jax.ShapeDtypeStruct((b, s, h, d), dt)
+    kp = jax.ShapeDtypeStruct((n_pages, P, h, d), dt)
+    vp = jax.ShapeDtypeStruct((n_pages, P, h, d), dt)
+    table = jax.ShapeDtypeStruct((b, mp), jnp.int32)
+    pos = jax.ShapeDtypeStruct((b,), jnp.int32)
+    return (functools.partial(_dispatch_paged, cand, scale=None),
+            (q, kp, vp, table, pos))
+
+
 def _register():
     from . import autotune as at
     at.register_family("decode_attn", _candidates, _runner,
                        cleanup=_cleanup, traceable=_traceable)
+    at.register_family("decode_attn_paged", _paged_candidates,
+                       _paged_runner, cleanup=_cleanup,
+                       traceable=_paged_traceable)
 
 
 _register()

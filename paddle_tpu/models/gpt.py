@@ -346,25 +346,28 @@ class GPTScanBlocks(Layer):
             flat = jax.random.split(_rnd.next_key(), c.num_hidden_layers * 3)
             keys = flat.reshape(c.num_hidden_layers, 3, *flat.shape[1:])
         if cache is not None and not isinstance(cache, (tuple, list)):
-            # slotted decode path: the per-layer walk re-enters inside ONE
-            # traced fn, over a clone of the view whose arrays are that
-            # trace's own arguments (and outputs — no tracer leaks onto
-            # the caller's view object)
+            # slotted/paged decode path: the per-layer walk re-enters
+            # inside ONE traced fn, over a clone of the view whose arrays
+            # are that trace's own arguments (and outputs — no tracer
+            # leaks onto the caller's view object).  The view declares
+            # which arrays it threads (carry_arrays: k/v/lengths for the
+            # slotted layout, + the page table for the paged one) and
+            # which come back mutated (k, v).
             seq = int(x.shape[1]) if hasattr(x, "shape") else 1
+            carries = cache.carry_arrays()
 
-            def raw_decode_slotted(x, params, kc, vc, lengths):
-                inner = cache.clone_raw(kc, vc, lengths)
+            def raw_decode_cached(x, params, *arrs):
+                inner = cache.clone_raw(*arrs)
                 for i in range(c.num_hidden_layers):
                     pi = {k: v[i] for k, v in params.items()}
                     x, _ = _scan_block_apply(x, pi, c, training=False,
                                              cache=inner)
-                return x, inner.k, inner.v
+                return (x,) + tuple(inner.mutated_arrays())
 
-            x_out, kc, vc = call(raw_decode_slotted, x, params,
-                                 cache.k, cache.v, cache.lengths,
-                                 name="gpt_scan_blocks")
-            cache.adopt(kc, vc, steps=seq)
-            return x_out, cache
+            out = call(raw_decode_cached, x, params, *carries,
+                       name="gpt_scan_blocks")
+            cache.adopt(*out[1:], steps=seq)
+            return out[0], cache
         if cache is not None:
             # LEGACY CONCAT SHIM decode path: python loop over leading-axis
             # slices (no grads); shapes grow per token — retraces every step
@@ -507,18 +510,22 @@ class GPTModel(Layer):
         finalize = False
         view = None
         if cache is not None and not isinstance(cache, (tuple, list)):
-            from ..serving.cache import (DecodeView, SlottedKVCache,
+            from ..serving.cache import (DecodeView, PagedDecodeView,
+                                         PagedKVCache, SlottedKVCache,
                                          is_cache_view)
             if isinstance(cache, SlottedKVCache):
                 # bare cache state -> batched decode semantics; the caller
                 # gets the advanced SlottedKVCache back
                 cache = DecodeView(cache)
                 finalize = True
+            elif isinstance(cache, PagedKVCache):
+                cache = PagedDecodeView(cache)
+                finalize = True
             if not is_cache_view(cache):
                 raise TypeError(
-                    "cache must be a SlottedKVCache, a serving cache view, "
-                    "or the legacy per-layer (k, v) tuple list; got %r"
-                    % (type(cache).__name__,))
+                    "cache must be a SlottedKVCache, a PagedKVCache, a "
+                    "serving cache view, or the legacy per-layer (k, v) "
+                    "tuple list; got %r" % (type(cache).__name__,))
             view = cache
         if position_ids is None:
             if view is not None:
@@ -596,6 +603,23 @@ class GPTForCausalLM(Layer):
             batch_size, c.num_hidden_layers,
             max_len or c.max_position_embeddings, c.num_attention_heads,
             c.hidden_size // c.num_attention_heads, dtype)
+
+    def gen_paged_cache(self, batch_size, dtype="float32", max_len=None,
+                        page_size=64):
+        """Preallocated paged KV cache (``serving.cache.PagedKVCache``)
+        with a DENSE identity page table — slot ``i`` owns its own page
+        run, so model-level use needs no allocator (the serving engine
+        builds the pooled/shared layout through ``serving.pages``).
+        ``model(x, cache=paged)`` decodes through the page-gather
+        attention path; capacity matches :meth:`gen_cache`."""
+        from ..serving.cache import PagedKVCache
+        c = self.config
+        return PagedKVCache.create_dense(
+            batch_size, c.num_hidden_layers,
+            max_len or c.max_position_embeddings, c.num_attention_heads,
+            c.hidden_size // c.num_attention_heads,
+            min(int(page_size), int(max_len or c.max_position_embeddings)),
+            dtype)
 
     def gen_legacy_concat_cache(self, batch_size, dtype="float32"):
         """COMPAT SHIM — the pre-serving concat-grown cache: the K/V

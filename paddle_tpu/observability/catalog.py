@@ -52,6 +52,24 @@ CATALOG = {
         "gauge", "active slots after the latest scheduler iteration"),
     "serving.queue_depth": _m(
         "gauge", "requests waiting for admission"),
+    "serving.page_pool_used": _m(
+        "gauge", "KV pages currently mapped by any slot (paged cache "
+        "occupancy; pool size is engine.num_pages)"),
+    "serving.prefix_hit_pages": _m(
+        "counter", "prompt pages served from the prefix hash cache at "
+        "admission instead of being recomputed/stored"),
+    "serving.cow_copies": _m(
+        "counter", "copy-on-write page copies (a write targeted a page "
+        "shared by another slot)"),
+    "serving.prefill_chunk_seconds": _m(
+        "histogram", "wall time of one chunked-prefill iteration (one "
+        "fixed-size chunk of one admission, interleaved with decode)",
+        unit="seconds"),
+    "serving.preemptions": _m(
+        "counter", "requests evicted under page-pool pressure and "
+        "requeued for recompute (vLLM-style preemption; a request "
+        "preempted past the scheduler's cap finishes 'cache_full' "
+        "instead)"),
 
     # -- training (TrainStep / hapi fit / amp / divergence sentinel) --------
     "train.step_seconds": _m(

@@ -12,17 +12,23 @@ models back) — engine/scheduler resolve lazily via module ``__getattr__``.
 """
 from __future__ import annotations
 
-from .cache import DecodeView, PrefillView, SlottedKVCache, is_cache_view
+from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
+                    PagedPrefillChunkView, PrefillView, SlottedKVCache,
+                    is_cache_view)
+from .pages import PageAllocator, PagePoolExhausted
 from .sampling import TOP_K_MAX, sample
 
 __all__ = [
-    "SlottedKVCache", "DecodeView", "PrefillView", "is_cache_view",
+    "SlottedKVCache", "DecodeView", "PrefillView", "PagedKVCache",
+    "PagedDecodeView", "PagedPrefillChunkView", "PageAllocator",
+    "PagePoolExhausted", "is_cache_view",
     "sample", "TOP_K_MAX", "DecodeEngine", "ContinuousBatchingScheduler",
-    "Request", "RequestResult", "generate", "engine_for",
+    "Request", "RequestResult", "PrefillTask", "generate", "engine_for",
 ]
 
 _LAZY = {
     "DecodeEngine": ("paddle_tpu.serving.engine", "DecodeEngine"),
+    "PrefillTask": ("paddle_tpu.serving.engine", "PrefillTask"),
     "ContinuousBatchingScheduler": ("paddle_tpu.serving.scheduler",
                                     "ContinuousBatchingScheduler"),
     "Request": ("paddle_tpu.serving.scheduler", "Request"),

@@ -1,37 +1,56 @@
-"""Static-shape slotted KV cache — the serving engine's memory layout.
+"""Static-shape KV cache layouts — the serving engine's memory.
 
 The decode path's non-negotiable TPU precondition is a *static-shape*
 program: the legacy cache grew by ``concat`` each token, so its shape
 changed every step and **every generated token retraced and recompiled
-the whole model**.  Here the cache is preallocated once as
+the whole model**.  Two static layouts live here:
 
-    k, v : (num_slots, layers, max_len, heads, head_dim)
-    lengths : (num_slots,) int32           # valid prefix per slot
+* :class:`SlottedKVCache` — per-slot contiguous (PR 5):
 
-and every append is an in-place-aliasable write (scatter at per-slot
-positions for batched decode, ``lax.dynamic_update_slice`` for
-single-slot prefill) into the *donated* buffers — the jitted decode step
-has ONE shape for the life of the process (Orca's iteration-level
-batching precondition; vLLM's PagedAttention solves the same problem
-with block tables, which static XLA shapes make unnecessary at these
-slot counts: a slot IS a page of ``max_len`` tokens).
+      k, v : (num_slots, layers, max_len, heads, head_dim)
+      lengths : (num_slots,) int32           # valid prefix per slot
 
-Attention over the cache is masked to each slot's valid prefix: the
+  Every slot pays (and the decode read streams around) a full
+  ``max_len`` buffer no matter how many tokens it actually holds.
+
+* :class:`PagedKVCache` — vLLM-style block-structured memory
+  (PagedAttention, SOSP '23) adapted to XLA's static-shape discipline:
+
+      k, v       : (num_pages, layers, page_size, heads, head_dim)
+      page_table : (num_slots, max_pages) int32   # page ids per slot
+      lengths    : (num_slots,) int32
+
+  A slot's tokens live in the fixed pool pages its page-table row maps;
+  decode appends scatter into the slot's current *tail* page and
+  attention gathers only mapped pages.  Memory (and the KV read bound a
+  page-aware schedule pays) scales with *actual* lengths, and identical
+  prompt prefixes can map the SAME refcounted pages (hash-based prefix
+  sharing — ``serving/pages.py`` owns the host-side allocator:
+  free list, refcounts, prefix hashes, copy-on-write decisions).  All
+  of it stays compile-once: the page table, lengths, and gather indices
+  are ordinary traced int32 arrays.
+
+Attention over either layout is masked to each slot's valid prefix: the
 query token at block offset ``j`` of a slot with pre-append length ``n``
 sits at global position ``n + j`` and may attend keys ``t <= n + j``.
 That one formula covers batched decode (``j = 0``), multi-token
-speculative steps, and whole-prompt prefill (``n = 0`` reduces it to the
-causal mask).
+appends, chunked prefill (``j`` ranges over the chunk), and whole-prompt
+prefill (``n = 0`` reduces it to the causal mask).
 
-Two *views* adapt the cache to the model's per-layer walk (they are
+*Views* adapt a cache to the model's per-layer walk (they are
 trace-time carriers, not pytrees — the arrays they hold thread through
 ``jit`` as ordinary tracers):
 
-* :class:`DecodeView` — batched: batch dim == num_slots, every active
-  slot advances together in one fixed-shape program.
-* :class:`PrefillView` — one sequence, one (dynamic) slot index, writes
-  rows ``[0, bucket)`` and runs plain block-causal attention (nothing
-  prior to attend to).
+* :class:`DecodeView` / :class:`PagedDecodeView` — batched: batch dim ==
+  num_slots, every active slot advances together in one fixed-shape
+  program.
+* :class:`PrefillView` — slotted bucketed prefill: one sequence, one
+  (dynamic) slot index, writes rows ``[0, bucket)`` and runs plain
+  block-causal attention (nothing prior to attend to).
+* :class:`PagedPrefillChunkView` — one fixed-size chunk of one slot's
+  prompt: writes positions ``[n, n + valid)`` into mapped pages and
+  attends to the full mapped past + itself (the chunked-prefill
+  program the engine interleaves with decode).
 
 Dependency note: this module is imported by ``models/gpt.py`` and must
 stay model-free (jax + the decode-attention kernel family only).
@@ -41,7 +60,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SlottedKVCache", "DecodeView", "PrefillView", "is_cache_view"]
+__all__ = ["SlottedKVCache", "DecodeView", "PrefillView", "PagedKVCache",
+           "PagedDecodeView", "PagedPrefillChunkView", "is_cache_view"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -88,6 +108,93 @@ class SlottedKVCache:
                 % (self.k.shape + (self.k.dtype,)))
 
 
+@jax.tree_util.register_pytree_node_class
+class PagedKVCache:
+    """Block-structured cache state: a fixed pool of fixed-size KV pages
+    plus a per-slot page table.  A registered pytree, so it passes through
+    ``jax.jit`` boundaries (and ``donate_argnums``) directly.  Unmapped
+    page-table entries hold 0 — they gather page 0's bytes, which the
+    length mask discards before they reach the softmax."""
+
+    def __init__(self, k, v, page_table, lengths, declared_max_len=None):
+        self.k = k
+        self.v = v
+        self.page_table = page_table
+        self.lengths = lengths
+        # the DECLARED length budget, when tighter than pool capacity
+        # (max_len % page_size != 0 leaves dead rows in the tail page);
+        # static aux data, so it survives jit boundaries and tree maps
+        self.declared_max_len = (None if declared_max_len is None
+                                 else int(declared_max_len))
+
+    def tree_flatten(self):
+        return ((self.k, self.v, self.page_table, self.lengths),
+                self.declared_max_len)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, declared_max_len=aux)
+
+    @classmethod
+    def create(cls, num_pages, num_layers, page_size, num_heads, head_dim,
+               num_slots, max_pages, dtype="float32"):
+        shape = (int(num_pages), int(num_layers), int(page_size),
+                 int(num_heads), int(head_dim))
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((int(num_slots), int(max_pages)), jnp.int32),
+                   jnp.zeros((int(num_slots),), jnp.int32))
+
+    @classmethod
+    def create_dense(cls, num_slots, num_layers, max_len, num_heads,
+                     head_dim, page_size, dtype="float32"):
+        """A pool with exactly one page set per slot, identity-mapped
+        (slot ``i`` owns pages ``[i*max_pages, (i+1)*max_pages)``) — the
+        allocator-free layout for model-level use (``gen_paged_cache``):
+        capacity matches the slotted cache, only the memory is paged."""
+        max_pages = -(-int(max_len) // int(page_size))
+        cache = cls.create(int(num_slots) * max_pages, num_layers,
+                           page_size, num_heads, head_dim, num_slots,
+                           max_pages, dtype)
+        table = jnp.arange(int(num_slots) * max_pages,
+                           dtype=jnp.int32).reshape(int(num_slots),
+                                                    max_pages)
+        return cls(cache.k, cache.v, table, cache.lengths,
+                   declared_max_len=int(max_len))
+
+    # -- static geometry (python ints — safe at trace time) ----------------
+    @property
+    def num_pages(self):
+        return int(self.k.shape[0])
+
+    @property
+    def num_layers(self):
+        return int(self.k.shape[1])
+
+    @property
+    def page_size(self):
+        return int(self.k.shape[2])
+
+    @property
+    def num_slots(self):
+        return int(self.page_table.shape[0])
+
+    @property
+    def max_pages(self):
+        return int(self.page_table.shape[1])
+
+    @property
+    def max_len(self):
+        cap = self.max_pages * self.page_size
+        return cap if self.declared_max_len is None \
+            else min(self.declared_max_len, cap)
+
+    def __repr__(self):
+        return ("PagedKVCache(pages=%d, layers=%d, page_size=%d, heads=%d, "
+                "head_dim=%d, slots=%d, max_pages=%d, dtype=%s)"
+                % (self.k.shape + self.page_table.shape[:2]
+                   + (self.k.dtype,)))
+
+
 def is_cache_view(obj) -> bool:
     return isinstance(obj, _CacheView)
 
@@ -96,13 +203,47 @@ def _unwrap(x):
     return x._array if hasattr(x, "_array") else x
 
 
+def paged_scatter(kc, vc, layer, table, pos, valid, k_new, v_new):
+    """Scatter ``k_new/v_new: (B, s, heads, head_dim)`` into page rows.
+
+    ``table: (B, max_pages)`` maps each lane's pages; ``pos: (B, s)`` are
+    global token positions; entries with ``valid`` False (inactive decode
+    lanes, chunk padding) — or positions past the table — are routed to
+    page id ``num_pages``, an out-of-bounds index XLA's default scatter
+    mode DROPS (the same trick the slotted cache uses for rows past
+    ``max_len``).  Distinct valid lanes never collide: the allocator
+    copy-on-writes any shared page before a write can target it."""
+    P = int(kc.shape[2])
+    max_pages = int(table.shape[1])
+    num_pages = int(kc.shape[0])
+    page_idx = pos // P                                    # (B, s) int32
+    safe_idx = jnp.clip(page_idx, 0, max_pages - 1)
+    page_id = jnp.take_along_axis(table, safe_idx, axis=1,
+                                  mode="promise_in_bounds")
+    page_id = jnp.where(valid & (page_idx < max_pages), page_id,
+                        jnp.asarray(num_pages, jnp.int32))
+    row = pos % P
+    l_idx = jnp.asarray(layer, jnp.int32)
+    kc = kc.at[page_id, l_idx, row].set(k_new.astype(kc.dtype))
+    vc = vc.at[page_id, l_idx, row].set(v_new.astype(vc.dtype))
+    return kc, vc
+
+
 class _CacheView:
     """Trace-time carrier threading the cache arrays through the model's
     per-layer walk.  Layers call :meth:`attend` (Tensor-level, tape-aware)
     or :meth:`attend_raw` (raw arrays, for the scan-layers block body) in
-    order; the view allocates layer indices from an internal cursor."""
+    order; the view allocates layer indices from an internal cursor.
 
-    def __init__(self, cache: SlottedKVCache):
+    ``_carry_fields`` names the traced arrays the view threads through a
+    re-entrant walk (the scan-layers path passes them across its own
+    ``call`` boundary via :meth:`carry_arrays`/:meth:`clone_raw`); the
+    first two — k, v — are the only ones a layer MUTATES
+    (:meth:`mutated_arrays`)."""
+
+    _carry_fields = ("k", "v", "lengths")
+
+    def __init__(self, cache):
         self.k = _unwrap(cache.k)
         self.v = _unwrap(cache.v)
         self.lengths = _unwrap(cache.lengths)
@@ -117,19 +258,31 @@ class _CacheView:
         self._layer = i + 1
         return i
 
+    def carry_arrays(self):
+        """The traced arrays a re-entrant walk must pass across its own
+        trace boundary, in :meth:`clone_raw` order."""
+        return tuple(getattr(self, f) for f in self._carry_fields)
+
+    def mutated_arrays(self):
+        """The subset of :meth:`carry_arrays` the walk mutates (k, v) —
+        what the re-entrant fn returns and :meth:`adopt` takes back."""
+        return (self.k, self.v)
+
     def attend(self, q, k_new, v_new, scale=None):
         """Tensor-level append+attend (dispatches through core.dispatch.call
         so eager autograd bookkeeping stays consistent)."""
         from ..core.dispatch import call
         layer = self._alloc_layer()
+        carry = self.carry_arrays()
+        n = len(carry)
 
-        def raw(kc, vc, lengths, q_, k_, v_):
+        def raw(*args):
             out, kc2, vc2 = self._append_attend_raw(
-                layer, kc, vc, lengths, q_, k_, v_, scale)
+                layer, args[:n], args[n], args[n + 1], args[n + 2], scale)
             return out, kc2, vc2
 
-        out, kc, vc = call(raw, self.k, self.v, self.lengths,
-                           q, k_new, v_new, name="slotted_kv_attend")
+        out, kc, vc = call(raw, *carry, q, k_new, v_new,
+                           name="slotted_kv_attend")
         self.k, self.v = _unwrap(kc), _unwrap(vc)
         return out
 
@@ -137,18 +290,23 @@ class _CacheView:
         """Raw-array append+attend (the scan-layers block body path)."""
         layer = self._alloc_layer()
         out, self.k, self.v = self._append_attend_raw(
-            layer, self.k, self.v, self.lengths, q, k_new, v_new, scale)
+            layer, self.carry_arrays(), q, k_new, v_new, scale)
         return out
 
-    def clone_raw(self, k, v, lengths):
-        """A fresh same-typed view over explicit raw arrays — for code that
-        re-enters the per-layer walk inside its own traced function (the
-        scan-layers decode path): the clone's arrays are that trace's
-        arguments, so no tracer ever leaks onto this view."""
+    def clone_raw(self, *arrays):
+        """A fresh same-typed view over explicit raw arrays (in
+        ``_carry_fields`` order) — for code that re-enters the per-layer
+        walk inside its own traced function (the scan-layers decode
+        path): the clone's arrays are that trace's arguments, so no
+        tracer ever leaks onto this view."""
         import copy
+        if len(arrays) != len(self._carry_fields):
+            raise ValueError("clone_raw expects %d arrays %r, got %d"
+                             % (len(self._carry_fields),
+                                self._carry_fields, len(arrays)))
         c = copy.copy(self)
-        c.k, c.v = _unwrap(k), _unwrap(v)
-        c.lengths = _unwrap(lengths)
+        for f, a in zip(self._carry_fields, arrays):
+            setattr(c, f, _unwrap(a))
         c._layer = 0
         return c
 
@@ -183,9 +341,9 @@ class DecodeView(_CacheView):
         return (self.lengths[:, None]
                 + jnp.arange(seq_len, dtype=jnp.int32)[None, :])
 
-    def _append_attend_raw(self, layer, kc, vc, lengths, q, k_new, v_new,
-                           scale):
+    def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels.decode_attention import decode_attention
+        kc, vc, lengths = carry
         s = int(q.shape[1])
         self._steps = s
         b_idx = jnp.arange(kc.shape[0], dtype=jnp.int32)[:, None]
@@ -225,10 +383,10 @@ class PrefillView(_CacheView):
                              % batch)
         return jnp.arange(seq_len, dtype=jnp.int32)[None, :]
 
-    def _append_attend_raw(self, layer, kc, vc, lengths, q, k_new, v_new,
-                           scale):
+    def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels import flash_attention as fa
         from ..nn.functional.attention import sdpa_reference_raw
+        kc, vc, lengths = carry
         zero = jnp.zeros((), jnp.int32)
         start = (self.slot, jnp.asarray(layer, jnp.int32), zero, zero, zero)
         kc = jax.lax.dynamic_update_slice(
@@ -248,3 +406,114 @@ class PrefillView(_CacheView):
     def finalize(self) -> SlottedKVCache:
         return SlottedKVCache(
             self.k, self.v, self.lengths.at[self.slot].set(self.true_len))
+
+
+class PagedDecodeView(_CacheView):
+    """Batched decode over the paged pool: q/k/v arrive as
+    ``(num_slots, s, heads, head_dim)``; each slot's new tokens scatter
+    into its mapped pages at rows ``lengths[b] + j`` and attention
+    gathers only the slot's page-table row.  Unlike the slotted view,
+    writes from INACTIVE lanes are dropped in-program (routed to an
+    out-of-bounds page id): a retired slot's stale table row may point at
+    pages the allocator has reassigned, so its lane must never write."""
+
+    _carry_fields = ("k", "v", "page_table", "lengths")
+
+    def __init__(self, cache: PagedKVCache, active=None, max_len=None):
+        super().__init__(cache)
+        self.page_table = _unwrap(cache.page_table)
+        self.active = None if active is None else _unwrap(active)
+        # write/length cap: the engine's DECLARED max_len can be tighter
+        # than the pool capacity when max_len % page_size != 0 — appends
+        # at or past it drop and lengths stop advancing, matching the
+        # slotted view's rows-past-max_len guard
+        self.max_len = (int(max_len) if max_len is not None
+                        else int(cache.max_len))
+        self._steps = 0
+
+    def position_ids(self, batch, seq_len):
+        if batch != int(self.page_table.shape[0]):
+            raise ValueError(
+                "batched paged decode needs batch == num_slots (%d), got "
+                "%d — use PagedPrefillChunkView for single sequences"
+                % (self.page_table.shape[0], batch))
+        return (self.lengths[:, None]
+                + jnp.arange(seq_len, dtype=jnp.int32)[None, :])
+
+    def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
+        from ..kernels.decode_attention import paged_decode_attention
+        kc, vc, table, lengths = carry
+        s = int(q.shape[1])
+        self._steps = s
+        pos = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        valid = pos < jnp.asarray(self.max_len, jnp.int32)
+        if self.active is not None:
+            valid = valid & self.active[:, None]
+        kc, vc = paged_scatter(kc, vc, layer, table, pos, valid,
+                               k_new, v_new)
+        out = paged_decode_attention(q, kc[:, layer], vc[:, layer], table,
+                                     lengths, scale=scale)
+        return out, kc, vc
+
+    def finalize(self) -> PagedKVCache:
+        adv = jnp.asarray(self._steps, jnp.int32)
+        if self.active is not None:
+            adv = adv * self.active.astype(jnp.int32)
+        return PagedKVCache(self.k, self.v, self.page_table,
+                            jnp.minimum(self.lengths + adv,
+                                        jnp.asarray(self.max_len,
+                                                    jnp.int32)),
+                            declared_max_len=self.max_len)
+
+
+class PagedPrefillChunkView(_CacheView):
+    """One fixed-size prefill chunk of one slot's prompt: input is
+    ``(1, chunk)`` right-padded tokens, ``n_valid`` of them real, at
+    global positions ``n_before + j``.  Writes land in the slot's mapped
+    pages (the engine allocates them host-side before the chunk runs);
+    padding writes are dropped in-program.  Attention gathers the slot's
+    page-table row and masks ``t <= n_before + j`` — the full mapped
+    past (shared prefix pages included) plus the chunk's own causal
+    band, so a chunk after a prefix-cache hit attends to pages it never
+    computed."""
+
+    _carry_fields = ("k", "v", "page_table", "lengths")
+
+    def __init__(self, cache: PagedKVCache, slot, n_before, n_valid):
+        super().__init__(cache)
+        self.page_table = _unwrap(cache.page_table)
+        self.slot = jnp.asarray(_unwrap(slot), jnp.int32)
+        self.n_before = jnp.asarray(_unwrap(n_before), jnp.int32)
+        self.n_valid = jnp.asarray(_unwrap(n_valid), jnp.int32)
+        self.declared_max_len = cache.declared_max_len
+
+    def position_ids(self, batch, seq_len):
+        if batch != 1:
+            raise ValueError(
+                "PagedPrefillChunkView is single-sequence (got batch=%d)"
+                % batch)
+        return (self.n_before
+                + jnp.arange(seq_len, dtype=jnp.int32))[None, :]
+
+    def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
+        from ..kernels.decode_attention import paged_decode_attention
+        kc, vc, table, lengths = carry
+        C = int(q.shape[1])
+        max_pages = int(table.shape[1])
+        row_tab = jax.lax.dynamic_slice(
+            table, (self.slot, jnp.zeros((), jnp.int32)), (1, max_pages))
+        j = jnp.arange(C, dtype=jnp.int32)
+        pos = (self.n_before + j)[None, :]
+        valid = (j < self.n_valid)[None, :]
+        kc, vc = paged_scatter(kc, vc, layer, row_tab, pos, valid,
+                               k_new, v_new)
+        out = paged_decode_attention(q, kc[:, layer], vc[:, layer],
+                                     row_tab, self.n_before[None],
+                                     scale=scale)
+        return out, kc, vc
+
+    def finalize(self) -> PagedKVCache:
+        return PagedKVCache(
+            self.k, self.v, self.page_table,
+            self.lengths.at[self.slot].set(self.n_before + self.n_valid),
+            declared_max_len=self.declared_max_len)

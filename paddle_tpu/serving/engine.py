@@ -1,31 +1,57 @@
-"""The TPU-native decode engine: static-shape slotted KV cache + a
-batched decode step that compiles exactly once.
+"""The TPU-native decode engine: static-shape KV cache + a batched
+decode step that compiles exactly once.
 
-Two compiled entry points over the :class:`~.cache.SlottedKVCache`:
+Two cache layouts (``paged=True`` is the default — ISSUE 7):
 
-* ``prefill`` — one sequence, right-padded to a power-of-two *bucket*
-  (bounding the jit cache to ``log2(max_len)`` programs), written into
-  one (dynamic) slot; samples the first token from the last real
-  position's logits.
-* ``decode`` — ALL slots advance one token in one fixed-shape program:
-  append at per-slot lengths, length-masked attention
-  (``kernels.decode_attention`` — autotune family ``decode_attn``),
-  per-slot temperature/top-k/top-p sampling with a threaded PRNG key.
-  Every argument that varies across steps (tokens, active mask, sampling
-  parameters, key) is a traced array — nothing retraces, ever; asserted
-  by ``decode_compile_count``.
+* **Paged** — a fixed pool of fixed-size KV pages plus a per-slot int32
+  page table (:class:`~.cache.PagedKVCache` + the host-side
+  :class:`~.pages.PageAllocator`).  Three compiled entry points:
 
-Both entries **donate the cache buffers** (k, v, lengths): XLA aliases
-them input→output, so the multi-hundred-MB cache is updated in place
-instead of double-buffered (TPU502 audits that the aliasing actually
-materializes — see ``analysis/trace/programs.py``'s ``serving`` builder).
+  - ``decode`` — ALL slots advance one token in one fixed-shape
+    program: scatter-append into each slot's tail page, paged-gather
+    length-masked attention (``kernels.decode_attention`` family
+    ``decode_attn_paged``), per-slot sampling.  Compiles ONCE.
+  - ``prefill_chunk`` — one fixed-size chunk of one slot's prompt:
+    admitting a long prompt runs ``ceil(n / chunk)`` iterations of this
+    ONE program, interleaved by the scheduler with live decode steps so
+    a long admission can no longer stall in-flight TPOT.  (This
+    replaces the slotted path's ``log2(max_len)`` bucketed prefill
+    programs with a single compile.)  The final chunk samples the first
+    generated token from the prompt's last position.
+  - ``cow_copy`` — copy one page (all layers) to a fresh page: the
+    copy-on-write step that un-shares a prefix page before a write.
+
+  **Prefix sharing**: prompt pages are content-hashed at admission; a
+  hit maps the slot's leading page-table entries to existing refcounted
+  pages instead of recomputing/storing them.  Sharing is capped at
+  ``n - 1`` tokens so the final token always runs through the chunk
+  program (producing the first-token logits); a fully-cached prompt
+  admits in ONE 1-token chunk, whose write copy-on-writes the shared
+  tail page.
+
+* **Slotted** (``paged=False`` — the PR-5 layout, kept for A/B and
+  parity): per-slot contiguous ``max_len`` buffers, bucketed whole-
+  prompt prefill.
+
+Every argument that varies across steps (tokens, active mask, sampling
+parameters, PRNG key, page table, lengths) is a traced array — nothing
+retraces, ever; asserted by ``decode_compile_count`` and the recompile
+watchdog.  All entries **donate the cache buffers**: XLA aliases them
+input→output, so the multi-hundred-MB pool is updated in place instead
+of double-buffered (TPU502 audits that the aliasing actually
+materializes — see ``analysis/trace/programs.py``'s ``serving``
+builder).  The page table is a per-step *input* (host-owned, re-uploaded
+only when it changes), not donated.
 
 The engine is deliberately request-free: slot admission/eviction policy
-lives in :mod:`.scheduler`.
+lives in :mod:`.scheduler`; the engine only refuses page allocation
+(:class:`~.pages.PagePoolExhausted`) and lets the scheduler pick a
+victim.
 """
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 
 import numpy as np
 
@@ -34,16 +60,20 @@ import jax.numpy as jnp
 
 from ..core.dtype import x64_scope
 from ..core.tensor import Tensor
-from .cache import DecodeView, PrefillView, SlottedKVCache
+from ..observability import registry as _metrics
+from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
+                    PagedPrefillChunkView, PrefillView, SlottedKVCache)
+from .pages import PageAllocator, PagePoolExhausted
 from .sampling import TOP_K_MAX, sample
 
-__all__ = ["DecodeEngine", "prefill_buckets_for"]
+__all__ = ["DecodeEngine", "PagePoolExhausted", "PrefillTask",
+           "prefill_buckets_for"]
 
 
 def prefill_buckets_for(max_len, min_bucket=16):
-    """Power-of-two prefill buckets up to ``max_len``; a non-power-of-two
-    ``max_len`` is appended as the final bucket so every prompt that fits
-    the cache has a bucket."""
+    """Power-of-two prefill buckets up to ``max_len`` (slotted mode); a
+    non-power-of-two ``max_len`` is appended as the final bucket so every
+    prompt that fits the cache has a bucket."""
     out = []
     b = min(int(min_bucket), int(max_len))
     while b <= int(max_len):
@@ -69,13 +99,32 @@ def _eval_scope(model):
             model.train()
 
 
+@dataclasses.dataclass
+class PrefillTask:
+    """Host-side state of one in-flight chunked admission."""
+    slot: int
+    ids: "np.ndarray"                     # the full prompt, int32
+    pos: int                              # next position to compute
+    temperature: float
+    top_k: int
+    top_p: float
+    shared_tokens: int = 0                # prefix-cache coverage (capped)
+    shared_pages: int = 0                 # pages mapped instead of computed
+    chunks_run: int = 0
+    done: bool = False
+    first_token: int = -1                 # sampled by the FINAL chunk
+    last_logits: object = None            # (vocab,) device array
+
+
 class DecodeEngine:
     """Compiled serving engine for a causal-LM Layer (``model(input_ids,
     cache=<view>) -> (logits, cache)`` with a ``config`` carrying the
     GPT geometry — :class:`paddle_tpu.models.gpt.GPTForCausalLM`)."""
 
     def __init__(self, model, num_slots=4, max_len=None, cache_dtype=None,
-                 min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True):
+                 min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True,
+                 paged=True, page_size=64, num_pages=None,
+                 prefill_chunk=None):
         cfg = model.config
         self.model = model
         self.num_slots = int(num_slots)
@@ -85,7 +134,7 @@ class DecodeEngine:
                 "max_len %d exceeds the model's position budget %d"
                 % (self.max_len, cfg.max_position_embeddings))
         self.top_k_max = int(top_k_max)
-        self.buckets = prefill_buckets_for(self.max_len, min_bucket)
+        self.paged = bool(paged)
         self.state = model.functional_state()
         if cache_dtype is None:
             # match the activation dtype: the embedding weight's dtype is
@@ -95,14 +144,37 @@ class DecodeEngine:
                            if probe is not None
                            else jnp.dtype(next(iter(self.state.values()
                                                     )).dtype))
-        self.cache = SlottedKVCache.create(
-            self.num_slots, cfg.num_hidden_layers, self.max_len,
-            cfg.num_attention_heads,
-            cfg.hidden_size // cfg.num_attention_heads, cache_dtype)
+        self._heads = cfg.num_attention_heads
+        self._head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self._layers = cfg.num_hidden_layers
+        self._cache_dtype = jnp.dtype(cache_dtype)
         self._base_key = jax.random.key(int(seed))
         self._rng_step = 0
+        # metric handles, fetched once (no-op singletons when disabled)
+        self._m_pool = _metrics.gauge("serving.page_pool_used")
+        self._m_cow = _metrics.counter("serving.cow_copies")
+        # decode KV-read accounting (the bench's kv_bytes_per_token A/B):
+        # per decode step, `paged_rows` accrues the rows a length-aware
+        # paged schedule reads (mapped pages) vs `flat_rows`, the slotted
+        # slots*max_len bound
+        self.kv_stats = {"tokens": 0, "paged_rows": 0, "flat_rows": 0}
+        if self.paged:
+            self._init_paged(cfg, page_size, num_pages, prefill_chunk,
+                             donate)
+        else:
+            self._init_slotted(cfg, min_bucket, donate)
 
-        k_max = self.top_k_max
+    # ------------------------------------------------------------------
+    # slotted mode (PR 5 layout — kept for A/B and parity)
+    # ------------------------------------------------------------------
+
+    def _init_slotted(self, cfg, min_bucket, donate):
+        self.buckets = prefill_buckets_for(self.max_len, min_bucket)
+        self.prompt_cap = self.buckets[-1]
+        model, k_max = self.model, self.top_k_max
+        self.cache = SlottedKVCache.create(
+            self.num_slots, self._layers, self.max_len, self._heads,
+            self._head_dim, self._cache_dtype)
 
         def decode_fn(state, cache_k, cache_v, lengths, tokens, active,
                       key, temps, top_ks, top_ps):
@@ -159,19 +231,144 @@ class DecodeEngine:
                     donate_argnums=self._prefill_donate_argnums),
             expected=len(self.buckets))
 
+    # ------------------------------------------------------------------
+    # paged mode (ISSUE 7 layout — the default)
+    # ------------------------------------------------------------------
+
+    def _init_paged(self, cfg, page_size, num_pages, prefill_chunk,
+                    donate):
+        self.page_size = min(int(page_size), self.max_len)
+        self.max_pages = -(-self.max_len // self.page_size)
+        # default pool: capacity parity with the slotted layout (every
+        # slot can reach max_len).  Size it SMALLER to actually save
+        # memory when typical lengths are short / prefixes shared.
+        self.num_pages = int(num_pages if num_pages is not None
+                             else self.num_slots * self.max_pages)
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else min(64, self.max_len))
+        self.prompt_cap = self.max_len
+        self._alloc = PageAllocator(self.num_pages, self.num_slots,
+                                    self.max_pages, self.page_size)
+        self._len_host = np.zeros((self.num_slots,), np.int64)
+        self.cache = PagedKVCache.create(
+            self.num_pages, self._layers, self.page_size, self._heads,
+            self._head_dim, self.num_slots, self.max_pages,
+            self._cache_dtype)
+        # hoist everything the traced closures need: capturing `self`
+        # would pin the whole engine (buffers included) to the jitted fns
+        model, k_max, L_max = self.model, self.top_k_max, self.max_len
+
+        def decode_fn(state, cache_k, cache_v, lengths, page_table,
+                      tokens, active, key, temps, top_ks, top_ps):
+            """One batched decode iteration over every slot (paged)."""
+            model.eval()
+            view = PagedDecodeView(
+                PagedKVCache(cache_k, cache_v, page_table, lengths),
+                active=active, max_len=L_max)
+            from ..jit import functional_call
+            (logits, _), _ = functional_call(model, state, Tensor(tokens),
+                                             cache=view)
+            logits = logits[:, -1, :]
+            next_tok = sample(logits, key, temps, top_ks, top_ps, k_max)
+            out = view.finalize()
+            return next_tok, logits, out.k, out.v, out.lengths
+
+        def prefill_chunk_fn(state, tokens, slot, n_before, n_valid,
+                             cache_k, cache_v, lengths, page_table, key,
+                             temp, top_k, top_p):
+            """One fixed-size chunk of one slot's prompt.  Samples a
+            token from the chunk's LAST REAL position — meaningful (and
+            used) only on the final chunk."""
+            model.eval()
+            view = PagedPrefillChunkView(
+                PagedKVCache(cache_k, cache_v, page_table, lengths),
+                slot, n_before, n_valid)
+            from ..jit import functional_call
+            (logits, _), _ = functional_call(model, state, Tensor(tokens),
+                                             cache=view)
+            last = jax.lax.dynamic_slice(
+                logits, (jnp.zeros((), jnp.int32),
+                         n_valid - jnp.ones((), jnp.int32),
+                         jnp.zeros((), jnp.int32)),
+                (1, 1, logits.shape[-1]))[:, 0, :]
+            tok = sample(last, key, temp[None], top_k[None], top_p[None],
+                         k_max)[0]
+            out = view.finalize()
+            return tok, last[0], out.k, out.v, out.lengths
+
+        def cow_copy_fn(cache_k, cache_v, src, dst):
+            """Copy one page (all layers) src -> dst: the copy-on-write
+            that un-shares a prefix page before a write targets it."""
+            src = jnp.asarray(src, jnp.int32)
+            dst = jnp.asarray(dst, jnp.int32)
+            k_page = jax.lax.dynamic_index_in_dim(cache_k, src, axis=0)
+            v_page = jax.lax.dynamic_index_in_dim(cache_v, src, axis=0)
+            zero = jnp.zeros((), jnp.int32)
+            start = (dst, zero, zero, zero, zero)
+            cache_k = jax.lax.dynamic_update_slice(cache_k, k_page, start)
+            cache_v = jax.lax.dynamic_update_slice(cache_v, v_page, start)
+            return cache_k, cache_v
+
+        self._decode_fn = decode_fn
+        self._decode_donate_argnums = (1, 2, 3) if donate else ()
+        self._prefill_chunk_fn = prefill_chunk_fn
+        self._prefill_chunk_donate_argnums = (5, 6, 7) if donate else ()
+        self._cow_fn = cow_copy_fn
+        self._cow_donate_argnums = (0, 1) if donate else ()
+        from ..observability.watchdog import watch
+        self._decode = watch(
+            "serving.decode",
+            jax.jit(decode_fn, donate_argnums=self._decode_donate_argnums),
+            expected=1)
+        # ONE chunk shape => ONE program (vs log2(max_len) buckets)
+        self._prefill_chunk = watch(
+            "serving.prefill_chunk",
+            jax.jit(prefill_chunk_fn,
+                    donate_argnums=self._prefill_chunk_donate_argnums),
+            expected=1)
+        self._cow = watch(
+            "serving.cow_copy",
+            jax.jit(cow_copy_fn,
+                    donate_argnums=self._cow_donate_argnums),
+            expected=1)
+
     # -- host-side API -----------------------------------------------------
 
     def refresh_state(self, state=None):
         """Re-snapshot the model's parameters (same shapes/dtypes — no
-        recompile).  Call after training between generate rounds."""
-        self.state = state if state is not None else \
+        recompile).  Call after training between generate rounds.  When
+        any parameter actually CHANGED, paged engines also drop the
+        prefix cache: its pages hold K/V computed under the old
+        parameters, and a hash hit would silently splice stale cache
+        into a fresh prompt.  Unchanged re-snapshots (every cached-
+        engine reuse via ``engine_for``) keep the cache — jax arrays are
+        immutable, so leaf identity is an exact change test."""
+        new = state if state is not None else \
             self.model.functional_state()
+        if self.paged:
+            old_leaves = jax.tree_util.tree_leaves(self.state)
+            new_leaves = jax.tree_util.tree_leaves(new)
+            if (len(old_leaves) != len(new_leaves)
+                    or any(a is not b
+                           for a, b in zip(new_leaves, old_leaves))):
+                self._alloc.drop_prefix_cache()
+        self.state = new
 
     def reset(self):
-        """Zero the cache lengths (slot contents are overwritten lazily)."""
-        self.cache = SlottedKVCache(
-            self.cache.k, self.cache.v,
-            jnp.zeros((self.num_slots,), jnp.int32))
+        """Free every slot (paged: pages return to the pool and prefix
+        hashes are purged; slot contents are overwritten lazily)."""
+        self.kv_stats = {"tokens": 0, "paged_rows": 0, "flat_rows": 0}
+        if self.paged:
+            self._alloc.reset()
+            self._len_host[:] = 0
+            self._m_pool.set(0)
+            self.cache = PagedKVCache(
+                self.cache.k, self.cache.v, self._alloc.device_table(),
+                jnp.zeros((self.num_slots,), jnp.int32))
+        else:
+            self.cache = SlottedKVCache(
+                self.cache.k, self.cache.v,
+                jnp.zeros((self.num_slots,), jnp.int32))
 
     def reseed(self, seed):
         """Restart the threaded key stream: after ``reseed(s)`` the next
@@ -182,6 +379,9 @@ class DecodeEngine:
         self._rng_step = 0
 
     def bucket_for(self, n):
+        if self.paged:
+            raise AttributeError("paged engines have no prefill buckets "
+                                 "(one chunk program) — use prefill_chunk")
         for b in self.buckets:
             if n <= b:
                 return b
@@ -193,11 +393,188 @@ class DecodeEngine:
         self._rng_step += 1
         return jax.random.fold_in(self._base_key, self._rng_step)
 
+    # -- paged page bookkeeping (host side) --------------------------------
+
+    def _set_length(self, slot, n):
+        """Host-side length write (admission bookkeeping — off the
+        per-token hot path)."""
+        self._len_host[slot] = int(n)
+        c = self.cache
+        self.cache = PagedKVCache(
+            c.k, c.v, c.page_table,
+            c.lengths.at[int(slot)].set(int(n)))
+
+    def free_slot(self, slot):
+        """Release a retired slot's pages (refcounted) and zero its
+        length.  Stale page-table entries are cleared so the decode
+        program's (dropped) inactive-lane writes can never target a
+        reassigned page."""
+        if not self.paged:
+            return
+        self._alloc.free_slot(int(slot))
+        self._set_length(int(slot), 0)
+        self._m_pool.set(self._alloc.pages_used())
+
+    def unshared_pages(self, slot):
+        """Pages ONLY this slot maps — the scheduler's refcount-aware
+        eviction score (freeing the max-unshared slot returns the most
+        pages to the pool)."""
+        return self._alloc.unshared_pages(int(slot)) if self.paged else 0
+
+    def pages_free(self):
+        return self._alloc.pages_free() if self.paged else 0
+
+    def _cow_page(self, slot, idx):
+        """Copy-on-write ``slot``'s page-table entry ``idx`` to a fresh
+        private page (raises PagePoolExhausted when the pool is dry)."""
+        new_pid = self._alloc.alloc()
+        old_pid = int(self._alloc.table[int(slot), int(idx)])
+        with x64_scope(False):
+            k, v = self._cow(self.cache.k, self.cache.v,
+                             jnp.asarray(old_pid, jnp.int32),
+                             jnp.asarray(new_pid, jnp.int32))
+        self._alloc.remap(int(slot), int(idx), new_pid)
+        self.cache = PagedKVCache(k, v, self.cache.page_table,
+                                  self.cache.lengths)
+        self._m_cow.inc()
+
+    def _ensure_write_range(self, slot, start, stop):
+        """Map (allocating) every page covering positions [start, stop)
+        of ``slot`` and copy-on-write any shared page the range writes
+        into.  Raises PagePoolExhausted if the pool is dry — the
+        scheduler evicts a victim and retries."""
+        P = self.page_size
+        for idx in range(int(start) // P, (int(stop) - 1) // P + 1):
+            if not self._alloc.mapped[slot, idx]:
+                self._alloc.map(slot, idx, self._alloc.alloc())
+            elif self._alloc.needs_cow(slot, idx):
+                self._cow_page(slot, idx)
+        self._m_pool.set(self._alloc.pages_used())
+
+    def ensure_decode_ready(self, active):
+        """Pre-step page bookkeeping for one batched decode: every
+        active slot's append position must land in a mapped, PRIVATE
+        page.  Returns the first slot index that could not get a page
+        (pool dry — evict and retry), or None when ready."""
+        if not self.paged:
+            return None
+        for i, on in enumerate(active):
+            if not on:
+                continue
+            p = int(self._len_host[i])
+            if p >= self.max_len:
+                continue        # scheduler retires this slot (cache_full)
+            try:
+                self._ensure_write_range(i, p, p + 1)
+            except PagePoolExhausted:
+                return i
+        return None
+
+    # -- prefill -----------------------------------------------------------
+
+    def prefill_begin(self, slot, token_ids, temperature=1.0, top_k=0,
+                      top_p=1.0) -> PrefillTask:
+        """Start admitting ``token_ids`` into ``slot``: map any
+        hash-matched prefix pages (capped at n-1 tokens so the final
+        token always runs through the chunk program and produces the
+        first-token logits), then return the task whose chunks
+        :meth:`prefill_step` advances."""
+        if not self.paged:
+            raise RuntimeError("chunked prefill is the paged path; "
+                               "slotted engines use prefill()")
+        ids = np.asarray(token_ids, np.int32).reshape(-1)
+        n = int(ids.size)
+        slot = int(slot)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.max_len:
+            raise ValueError("prompt length %d > max_len %d"
+                             % (n, self.max_len))
+        if self._alloc.slot_pages(slot) or self._len_host[slot]:
+            raise RuntimeError("slot %d admitted without free_slot()"
+                               % slot)
+        shared_pages, covered = self._alloc.lookup_prefix(ids)
+        covered = min(covered, n - 1)
+        # map only the pages the capped prefix actually covers (a capped
+        # full hit keeps its tail page: its rows [.., n-1) stay valid
+        # cache and the final chunk's write copy-on-writes it)
+        P = self.page_size
+        n_map = -(-covered // P) if covered else 0
+        for idx in range(n_map):
+            self._alloc.share(slot, idx, shared_pages[idx])
+        self._set_length(slot, covered)
+        self._m_pool.set(self._alloc.pages_used())
+        return PrefillTask(slot=slot, ids=ids, pos=covered,
+                           temperature=float(temperature),
+                           top_k=int(top_k), top_p=float(top_p),
+                           shared_tokens=covered, shared_pages=n_map)
+
+    def prefill_step(self, task: PrefillTask) -> bool:
+        """Run ONE chunk of an admission; returns True when the prompt
+        is fully prefilled (``task.first_token``/``task.last_logits``
+        are then set).  Raises PagePoolExhausted when the chunk's pages
+        cannot be mapped — the scheduler evicts a victim and retries."""
+        if task.done:
+            return True
+        n = int(task.ids.size)
+        n_valid = min(self.prefill_chunk, n - task.pos)
+        self._ensure_write_range(task.slot, task.pos, task.pos + n_valid)
+        padded = np.zeros((1, self.prefill_chunk), np.int32)
+        padded[0, :n_valid] = task.ids[task.pos:task.pos + n_valid]
+        # only the FINAL chunk's sample is used, so only it may consume
+        # a key from the threaded stream: the chunk COUNT depends on
+        # prefix-cache state (a hit collapses the admission to one
+        # 1-token chunk), and a per-chunk draw would shift every later
+        # sample's key — generate(seed=s) must reproduce on a cached
+        # engine (tested).  Non-final chunks get the never-used step-0
+        # fold (_rng_step starts at 1, so it collides with nothing).
+        final = task.pos + n_valid >= n
+        key = (self._next_key() if final
+               else jax.random.fold_in(self._base_key, 0))
+        # x64_scope(False) covers the (first-call) TRACE: the serving
+        # programs carry no s64/f64 — jax.random's counters and gather
+        # index widening follow the global x64 default otherwise (same
+        # discipline as the Pallas kernel entries; asserted over the
+        # compiled HLO by tests/test_serving.py)
+        with x64_scope(False), _eval_scope(self.model):
+            tok, logits, k, v, lengths = self._prefill_chunk(
+                self.state, jnp.asarray(padded),
+                jnp.asarray(task.slot, jnp.int32),
+                jnp.asarray(task.pos, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32),
+                self.cache.k, self.cache.v, self.cache.lengths,
+                self._alloc.device_table(), key,
+                jnp.asarray(task.temperature, jnp.float32),
+                jnp.asarray(min(task.top_k, self.top_k_max), jnp.int32),
+                jnp.asarray(task.top_p, jnp.float32))
+        self.cache = PagedKVCache(k, v, self._alloc.device_table(),
+                                  lengths)
+        task.pos += n_valid
+        task.chunks_run += 1
+        self._len_host[task.slot] = task.pos
+        if task.pos >= n:
+            task.done = True
+            task.first_token = int(tok)
+            task.last_logits = logits
+            # publish this prompt's pages for later admissions to share
+            self._alloc.register_prefix(task.slot, task.ids)
+        return task.done
+
     def prefill(self, slot, token_ids, temperature=1.0, top_k=0,
                 top_p=1.0):
         """Admit ``token_ids`` (1-D) into ``slot``; returns the sampled
         first token (int) and the last-position logits (a jax array,
-        (vocab,) — left on device; np.asarray() it if needed host-side)."""
+        (vocab,) — left on device; np.asarray() it if needed host-side).
+
+        Paged mode: runs every chunk back to back (the scheduler uses
+        :meth:`prefill_begin`/:meth:`prefill_step` to interleave chunks
+        with decode instead)."""
+        if self.paged:
+            task = self.prefill_begin(slot, token_ids, temperature, top_k,
+                                      top_p)
+            while not self.prefill_step(task):
+                pass
+            return task.first_token, task.last_logits
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         n = int(ids.size)
         if n < 1:
@@ -208,11 +585,7 @@ class DecodeEngine:
         bucket = self.bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = ids
-        # x64_scope(False) covers the (first-call) TRACE: the serving
-        # programs carry no s64/f64 — jax.random's counters and gather
-        # index widening follow the global x64 default otherwise (same
-        # discipline as the Pallas kernel entries; asserted over the
-        # compiled HLO by tests/test_serving.py)
+        # x64/eval scopes: see prefill_step()
         with x64_scope(False), _eval_scope(self.model):
             tok, logits, k, v, lengths = self._prefill(
                 self.state, jnp.asarray(padded),
@@ -225,28 +598,84 @@ class DecodeEngine:
         self.cache = SlottedKVCache(k, v, lengths)
         return int(tok), logits
 
-    def decode(self, tokens, active, temperature, top_k, top_p):
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, tokens, active, temperature, top_k, top_p,
+               pages_ready=False):
         """One batched decode step.  All inputs are per-slot host arrays
         of length ``num_slots``; returns (next_tokens as an np array,
         logits as a jax device array) — callers ignore entries of
-        inactive slots."""
+        inactive slots.  ``pages_ready=True`` skips the per-slot page
+        bookkeeping — for callers (the scheduler) that already ran
+        :meth:`ensure_decode_ready` this step to drive eviction;
+        direct callers keep the default check-and-raise."""
         toks = np.asarray(tokens, np.int32).reshape(self.num_slots, 1)
-        # x64/eval scopes: see prefill() — keep the traced program
+        active_np = np.asarray(active, bool).reshape(self.num_slots)
+        if self.paged and not pages_ready:
+            blocked = self.ensure_decode_ready(active_np)
+            if blocked is not None:
+                raise PagePoolExhausted(
+                    "no free page for slot %d's append — evict a slot "
+                    "(the scheduler does this refcount-aware)" % blocked)
+        # x64/eval scopes: see prefill_step() — keep the traced program
         # s64/f64-free and the caller's train/eval mode untouched
         with x64_scope(False), _eval_scope(self.model):
+            # both layouts share one call shape; paged inserts the page
+            # table after lengths (donated argnums 1-3 are identical)
+            table = (self._alloc.device_table(),) if self.paged else ()
             tok, logits, k, v, lengths = self._decode(
-                self.state, self.cache.k, self.cache.v, self.cache.lengths,
-                jnp.asarray(toks), jnp.asarray(np.asarray(active, bool)),
+                self.state, self.cache.k, self.cache.v,
+                self.cache.lengths, *table,
+                jnp.asarray(toks), jnp.asarray(active_np),
                 self._next_key(),
                 jnp.asarray(np.asarray(temperature, np.float32)),
                 jnp.asarray(np.minimum(np.asarray(top_k, np.int32),
                                        self.top_k_max)),
                 jnp.asarray(np.asarray(top_p, np.float32)))
-        self.cache = SlottedKVCache(k, v, lengths)
+            self.kv_stats["tokens"] += int(active_np.sum())
+            self.kv_stats["flat_rows"] += self.num_slots * self.max_len
+            if self.paged:
+                self.cache = PagedKVCache(k, v, self._alloc.device_table(),
+                                          lengths)
+                # mirror the program's finalize exactly: lengths advance
+                # for every active lane but clamp at max_len — a direct
+                # caller keeping a full lane active has its append
+                # dropped in-program, so the mirror must not advance
+                # past it either
+                self._len_host[active_np] += 1
+                np.minimum(self._len_host, self.max_len,
+                           out=self._len_host)
+                self.kv_stats["paged_rows"] += \
+                    self._alloc.mapped_rows_total()
+            else:
+                # the slotted read bound IS the flat slots*max_len sweep
+                self.cache = SlottedKVCache(k, v, lengths)
         return np.asarray(tok), logits
 
     def slot_lengths(self):
+        """Per-slot valid lengths.  Paged mode serves the host mirror —
+        no device->host sync on the scheduler's per-iteration path."""
+        if self.paged:
+            return self._len_host.copy()
         return np.asarray(self.cache.lengths)
+
+    def kv_bytes_per_token(self):
+        """Observed decode KV-read accounting: bytes per generated token
+        under (a) the paged true-length bound and (b) the slotted
+        ``slots*max_len`` bound — the bench's A/B line.  Row cost covers
+        K+V across all layers.  Slotted engines report only ``flat``
+        (their real read bound): a fabricated ``paged: 0.0`` would read
+        as a datum in the A/B trajectory."""
+        row = (self._layers * self._heads * self._head_dim * 2
+               * self._cache_dtype.itemsize)
+        t = self.kv_stats["tokens"]
+        out = {"flat": (float(self.num_slots * self.max_len * row)
+                        if not t    # no decode yet: the static bound
+                        else self.kv_stats["flat_rows"] * row / t)}
+        if self.paged:
+            out["paged"] = (0.0 if not t
+                            else self.kv_stats["paged_rows"] * row / t)
+        return out
 
     # -- compile accounting (the "compiles exactly once" contract) ---------
 
@@ -257,7 +686,9 @@ class DecodeEngine:
 
     @property
     def prefill_compile_count(self):
-        """<= len(self.buckets) by construction."""
+        """Paged: the single chunk program; slotted: <= len(buckets)."""
+        if self.paged:
+            return int(self._prefill_chunk._cache_size())
         return int(self._prefill._cache_size())
 
     # -- audit hooks (analysis/trace/programs.py `serving` builder) --------
@@ -267,15 +698,32 @@ class DecodeEngine:
         not drawn from the engine stream — lowering an audit must not
         shift the live engine's sampling sequence)."""
         s = self.num_slots
-        return (self.state, self.cache.k, self.cache.v, self.cache.lengths,
-                jnp.zeros((s, 1), jnp.int32), jnp.ones((s,), bool),
-                jax.random.key(0), jnp.ones((s,), jnp.float32),
-                jnp.zeros((s,), jnp.int32), jnp.ones((s,), jnp.float32))
+        common = (jnp.zeros((s, 1), jnp.int32), jnp.ones((s,), bool),
+                  jax.random.key(0), jnp.ones((s,), jnp.float32),
+                  jnp.zeros((s,), jnp.int32), jnp.ones((s,), jnp.float32))
+        if self.paged:
+            return (self.state, self.cache.k, self.cache.v,
+                    self.cache.lengths, self._alloc.device_table()) + common
+        return (self.state, self.cache.k, self.cache.v,
+                self.cache.lengths) + common
 
     def prefill_trace_args(self, bucket=None):
+        if self.paged:
+            raise RuntimeError("paged engines trace prefill_chunk — use "
+                               "prefill_chunk_trace_args()")
         b = int(bucket or self.buckets[0])
         return (self.state, jnp.zeros((1, b), jnp.int32),
                 jnp.zeros((), jnp.int32), jnp.asarray(b, jnp.int32),
                 self.cache.k, self.cache.v, self.cache.lengths,
                 jax.random.key(0), jnp.ones((), jnp.float32),
                 jnp.zeros((), jnp.int32), jnp.ones((), jnp.float32))
+
+    def prefill_chunk_trace_args(self):
+        C = self.prefill_chunk
+        return (self.state, jnp.zeros((1, C), jnp.int32),
+                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                jnp.asarray(min(C, self.max_len), jnp.int32),
+                self.cache.k, self.cache.v, self.cache.lengths,
+                self._alloc.device_table(), jax.random.key(0),
+                jnp.ones((), jnp.float32), jnp.zeros((), jnp.int32),
+                jnp.ones((), jnp.float32))

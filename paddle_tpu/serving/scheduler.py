@@ -4,15 +4,39 @@ host side of the compiled decode step.
 The unit of scheduling is ONE decode iteration, not one request: after
 every batched step the scheduler retires finished slots (EOS /
 ``max_new_tokens`` / cache-full) and immediately admits waiting requests
-into the freed slots via bucketed prefill — the batch composition
-changes between iterations while the decode program (fixed shape: all
-``num_slots`` lanes every step) never recompiles.
+into the freed slots — the batch composition changes between iterations
+while the decode program (fixed shape: all ``num_slots`` lanes every
+step) never recompiles.
 
-States of a slot: ``free`` → (admit: prefill, samples the first token)
-→ ``active`` → (EOS | budget | ``max_len``) → ``free``.  Admission is
-strict FIFO over the waiting queue; prefill lengths are bucketed to
-powers of two (``engine.buckets``) so the prefill jit cache is bounded
-by ``log2(max_len)`` programs.
+**Chunked prefill (paged engines — the default).**  Admission no longer
+runs the whole prompt in one blocking call: it starts a
+:class:`~.engine.PrefillTask` and each scheduler iteration advances
+every admitting slot by ONE fixed-size chunk *between* decode steps, so
+a 32k-token admission costs each in-flight request one chunk of extra
+latency per token instead of one whole-prompt stall (TPOT
+non-interference — tested).  A prefix-cache hit skips the shared pages
+entirely (the counter ``serving.prefix_hit_pages`` meters it) and a
+fully-cached prompt admits in a single 1-token chunk.
+
+**Refcount-aware eviction, preemption by recompute.**  When the page
+pool is dry (a decode append or a prefill chunk cannot map a page), the
+victim is the active slot with the MOST unshared pages — freeing it
+returns the most pages to the pool, whereas evicting a slot whose pages
+are mostly shared prefix frees almost nothing (bare FIFO would thrash
+exactly those slots under a prefix-heavy workload — tested).  Ties
+break oldest-first.  The victim is not lost: it goes back to the front
+of the waiting queue and, on re-admission, re-prefills
+``prompt + generated-so-far`` (vLLM-style recompute preemption) — a
+recompute that mostly prefix-hits the victim's own still-cached pages.
+A request evicted more than ``max_preemptions`` times, or one whose
+sequence the pool cannot hold even alone, finishes ``"cache_full"``.
+
+States of a slot: ``free`` → (admit: begin prefill) → ``prefilling`` →
+(final chunk samples the first token) → ``active`` → (EOS | budget |
+``max_len`` | evicted-past-cap) → ``free``, with ``active``/
+``prefilling`` → (preempted) → ``waiting`` → ``prefilling``.  Admission
+is strict FIFO over the waiting queue.  Slotted engines
+(``paged=False``) keep the PR-5 one-shot bucketed prefill.
 
 Per-request timing is recorded for the serving metrics the bench emits:
 TTFT (submit → first token — still INCLUDES queue wait, for continuity
@@ -20,10 +44,9 @@ with the PR-5 trajectory), ``queue_wait`` (submit → admission, reported
 separately so load tests can subtract it: under saturation TTFT is
 dominated by queueing, not prefill), and TPOT (mean decode seconds per
 subsequent token).  Every iteration also feeds the process-wide metrics
-registry (paddle_tpu.observability — TTFT/TPOT/queue-wait histograms,
-slot occupancy, prefill bucket hits, finish reasons, tokens); handles are
-fetched once at construction, so with metrics disabled the per-token path
-is a no-op method call with zero host allocation.
+registry (paddle_tpu.observability); handles are fetched once at
+construction, so with metrics disabled the per-token path is a no-op
+method call with zero host allocation.
 """
 from __future__ import annotations
 
@@ -35,6 +58,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observability import registry as _metrics
+from .engine import PagePoolExhausted
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
 
@@ -56,32 +80,66 @@ class RequestResult:
     tokens: "np.ndarray"                 # generated ids (prompt excluded)
     finish_reason: str                   # "eos" | "length" | "cache_full"
     ttft: float                          # submit -> first token, seconds
-    tpot: float                          # mean secs/token after the first
+    tpot: float                          # mean secs per timed decode step
+                                         # (prefill-sampled tokens, incl. a
+                                         # resume's, are excluded)
     queue_wait: float = 0.0              # submit -> admission, seconds
+    prefix_hit_tokens: int = 0           # tokens served from the prefix
+                                         # cache, all admissions (a
+                                         # preemption resume's hits count)
 
 
 class _ActiveSlot:
     __slots__ = ("req", "generated", "submit_t", "first_tok_t", "last_t",
-                 "decode_s", "queue_wait")
+                 "decode_s", "decode_steps", "queue_wait", "prefill_task",
+                 "admit_order", "prefix_hit_tokens")
 
-    def __init__(self, req, first_token, submit_t, now, queue_wait=0.0):
+    def __init__(self, req, submit_t, queue_wait, admit_order,
+                 prefill_task=None):
         self.req = req
-        self.generated = [int(first_token)]
+        self.generated: List[int] = []
         self.submit_t = submit_t
-        self.first_tok_t = now
-        self.last_t = now
+        self.first_tok_t = None
+        self.last_t = None
         self.decode_s = 0.0
+        self.decode_steps = 0          # timed decode appends only: a
+                                       # preemption resume's prefill-
+                                       # sampled token adds no decode_s,
+                                       # so len(generated)-1 would
+                                       # deflate TPOT
         self.queue_wait = queue_wait
+        self.prefill_task = prefill_task   # None once prefill completed
+        self.admit_order = admit_order     # FIFO tie-break for eviction
+        self.prefix_hit_tokens = (prefill_task.shared_tokens
+                                  if prefill_task is not None else 0)
+
+    def first_token(self, tok, now):
+        self.generated.append(int(tok))
+        # a resumed (preempted) slot's recompute-prefill also lands
+        # here: its true first-token time is the original one
+        if self.first_tok_t is None:
+            self.first_tok_t = now
+        self.last_t = now
 
 
 class ContinuousBatchingScheduler:
+    # page-pressure evictions per request before the scheduler stops
+    # requeueing it and finishes it "cache_full" — bounds wasted
+    # recompute and keeps run()'s termination argument trivial
+    max_preemptions = 3
+
     def __init__(self, engine):
         self.engine = engine
         self.waiting: deque = deque()
         self.slots: List[Optional[_ActiveSlot]] = [None] * engine.num_slots
         self.finished: Dict[int, RequestResult] = {}
         self._next_rid = 0
+        self._admit_seq = 0
         self._submit_t: Dict[int, float] = {}
+        # rid -> parked _ActiveSlot (evicted, waiting to resume) and
+        # rid -> times evicted; see _preempt()
+        self._preempted: Dict[int, _ActiveSlot] = {}
+        self._preempt_count: Dict[int, int] = {}
         # metric handles, fetched ONCE: with the registry disabled these
         # are the shared no-op singletons — the per-token hot path then
         # does nothing and allocates nothing (tests/test_observability.py
@@ -91,9 +149,13 @@ class ContinuousBatchingScheduler:
         self._m_tpot = _metrics.histogram("serving.tpot_seconds")
         self._m_decode_step = _metrics.histogram(
             "serving.decode_step_seconds")
+        self._m_prefill_chunk = _metrics.histogram(
+            "serving.prefill_chunk_seconds")
         self._m_tokens = _metrics.counter("serving.generated_tokens")
         self._m_bucket_hits = _metrics.counter(
             "serving.prefill_bucket_hits", ("bucket",))
+        self._m_prefix_hits = _metrics.counter("serving.prefix_hit_pages")
+        self._m_preempt = _metrics.counter("serving.preemptions")
         self._m_finished = _metrics.counter(
             "serving.finished_requests", ("reason",))
         self._m_occupancy = _metrics.gauge("serving.slot_occupancy")
@@ -105,10 +167,11 @@ class ContinuousBatchingScheduler:
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
-        if prompt.size > self.engine.buckets[-1]:
+        cap = self.engine.prompt_cap
+        if prompt.size > cap:
             raise ValueError(
-                "prompt length %d exceeds the largest prefill bucket %d"
-                % (prompt.size, self.engine.buckets[-1]))
+                "prompt length %d exceeds the engine's prompt capacity %d"
+                % (prompt.size, cap))
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         req = dataclasses.replace(req, prompt=prompt, rid=self._next_rid)
@@ -122,27 +185,38 @@ class ContinuousBatchingScheduler:
 
     def _finish(self, idx: int, reason: str):
         act = self.slots[idx]
-        n = len(act.generated)
-        tpot = (act.decode_s / (n - 1)) if n > 1 else 0.0
-        ttft = act.first_tok_t - act.submit_t
+        tpot = (act.decode_s / act.decode_steps) if act.decode_steps \
+            else 0.0
+        # a request evicted before producing any token (cache_full mid-
+        # prefill) has no first-token time: its ttft is reported as 0.0
+        # and NOT fed to the histogram — a fabricated eviction-time
+        # sample would pollute the p50/p99 TTFT the bench reports
+        got_first = act.first_tok_t is not None
+        ttft = (act.first_tok_t - act.submit_t) if got_first else 0.0
         self.finished[act.req.rid] = RequestResult(
             rid=act.req.rid, tokens=np.asarray(act.generated, np.int32),
             finish_reason=reason, ttft=ttft, tpot=tpot,
-            queue_wait=act.queue_wait)
+            queue_wait=act.queue_wait,
+            prefix_hit_tokens=act.prefix_hit_tokens)
         self.slots[idx] = None
+        self.engine.free_slot(idx)     # paged: pages back to the pool
+        self._preempt_count.pop(act.req.rid, None)
         self._m_finished.labels(reason=reason).inc()
-        self._m_ttft.observe(ttft)
-        if n > 1:
+        if got_first:
+            self._m_ttft.observe(ttft)
+        if act.decode_steps:
             self._m_tpot.observe(tpot)
 
     def _check_finished(self, idx: int, lengths):
         """Retire the slot if its latest token ended the request.
-        ``lengths`` is the post-step host copy of the engine's per-slot
-        lengths — fetched ONCE per scheduler iteration by the caller (a
-        per-slot engine.slot_lengths() here would be a device->host
+        ``lengths`` is the post-step per-slot lengths — fetched ONCE per
+        scheduler iteration by the caller (paged engines serve a host
+        mirror; a per-slot device fetch here would be a device->host
         round-trip on the decode hot path, per slot per token)."""
         act = self.slots[idx]
         req = act.req
+        if not act.generated:
+            return
         tok = act.generated[-1]
         if req.eos_token_id is not None and tok == int(req.eos_token_id):
             self._finish(idx, "eos")
@@ -152,9 +226,77 @@ class ContinuousBatchingScheduler:
             # no room for another append — retire rather than overflow
             self._finish(idx, "cache_full")
 
+    # -- refcount-aware eviction (page pool pressure) ----------------------
+
+    def _preempt(self, idx: int):
+        """vLLM-style recompute preemption: park the slot's state, free
+        its pages, and put the request back at the FRONT of the waiting
+        queue.  On re-admission the request re-prefills
+        ``prompt + generated`` — greedy continuation is unchanged and
+        the recompute mostly prefix-hits the victim's own still-cached
+        (refcount-0 but hash-reachable) pages — instead of being
+        finished with whatever it had: a victim evicted mid-prefill
+        would otherwise silently return an EMPTY token array through
+        ``generate()``."""
+        act = self.slots[idx]
+        rid = act.req.rid
+        self.slots[idx] = None
+        self.engine.free_slot(idx)     # pages back (shared: refcount--)
+        act.prefill_task = None        # chunk state is page-bound: drop
+        self.waiting.appendleft(act.req)
+        self._submit_t[rid] = act.submit_t
+        self._preempted[rid] = act
+        self._m_preempt.inc()
+        self._m_queue_depth.set(len(self.waiting))
+
+    def _evict_for_pages(self, requester_idx: int) -> bool:
+        """Free pages by preempting one slot.  Victim: the occupied
+        slot with the MOST unshared pages (what eviction actually
+        returns to the pool — a prefix-heavy slot's shared pages only
+        drop a refcount), preferring slots other than the requester;
+        ties break oldest-admitted-first.  The victim is requeued for
+        recompute unless it has already been evicted
+        ``max_preemptions`` times (then it finishes "cache_full" — the
+        cap bounds wasted recompute and preserves termination).
+        Returns False only when the requester itself was the last
+        occupant: a sequence the pool cannot hold alone is finished
+        "cache_full", never requeued (it would loop forever)."""
+        candidates = [i for i, a in enumerate(self.slots)
+                      if a is not None and i != requester_idx]
+        if not candidates:
+            self._finish(requester_idx, "cache_full")
+            return False
+        victim = max(candidates,
+                     key=lambda i: (self.engine.unshared_pages(i),
+                                    -self.slots[i].admit_order))
+        rid = self.slots[victim].req.rid
+        n = self._preempt_count.get(rid, 0) + 1
+        self._preempt_count[rid] = n
+        if n > self.max_preemptions:
+            self._finish(victim, "cache_full")
+        else:
+            self._preempt(victim)
+        return True
+
+    # -- admission ---------------------------------------------------------
+
+    def _begin_paged(self, idx: int, req: Request, ids):
+        """Start a chunked-prefill admission of ``ids`` into ``idx`` —
+        the one place for the prefill_begin call and its prefix-hit
+        metric (fresh admissions and preemption resumes both land
+        here)."""
+        task = self.engine.prefill_begin(
+            idx, ids, temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p)
+        if task.shared_pages:
+            self._m_prefix_hits.inc(task.shared_pages)
+        return task
+
     def admit(self) -> int:
-        """Fill free slots from the waiting queue (FIFO).  Each admission
-        is one bucketed prefill; returns how many were admitted."""
+        """Fill free slots from the waiting queue (FIFO).  Paged engines
+        only BEGIN the prefill here (chunks run in :meth:`step`,
+        interleaved with decode); slotted engines run their one-shot
+        bucketed prefill.  Returns how many requests were admitted."""
         n = 0
         for idx in range(self.engine.num_slots):
             if self.slots[idx] is not None or not self.waiting:
@@ -163,38 +305,112 @@ class ContinuousBatchingScheduler:
             # a request whose prompt+budget exceeds max_len is still
             # admissible — generation just ends early with "cache_full"
             submit_t = self._submit_t.pop(req.rid)
+            resumed = self._preempted.pop(req.rid, None)
+            order = self._admit_seq
+            self._admit_seq += 1
+            if resumed is not None:
+                # recompute-resume a preempted request: re-prefill
+                # prompt + generated so the next sampled token continues
+                # the sequence; timing state (ttft, decode_s) and the
+                # token list survive on the parked slot.  queue_wait is
+                # NOT re-observed — one histogram sample per request.
+                ids = req.prompt
+                if resumed.generated:
+                    ids = np.concatenate(
+                        [ids, np.asarray(resumed.generated, np.int32)])
+                task = self._begin_paged(idx, req, ids)
+                # keep the per-request field consistent with the
+                # registry counter: resume hits are cache-served work too
+                resumed.prefix_hit_tokens += task.shared_tokens
+                resumed.prefill_task = task
+                resumed.admit_order = order
+                self.slots[idx] = resumed
+                n += 1
+                continue
             admit_t = time.perf_counter()
             queue_wait = admit_t - submit_t
             self._m_queue_wait.observe(queue_wait)
-            self._m_bucket_hits.labels(
-                bucket=self.engine.bucket_for(req.prompt.size)).inc()
-            tok, _logits = self.engine.prefill(
-                idx, req.prompt, temperature=req.temperature,
-                top_k=req.top_k, top_p=req.top_p)
-            now = time.perf_counter()
-            self.slots[idx] = _ActiveSlot(req, tok, submit_t, now,
-                                          queue_wait)
+            if self.engine.paged:
+                task = self._begin_paged(idx, req, req.prompt)
+                self.slots[idx] = _ActiveSlot(req, submit_t, queue_wait,
+                                              order, prefill_task=task)
+            else:
+                self._m_bucket_hits.labels(
+                    bucket=self.engine.bucket_for(req.prompt.size)).inc()
+                tok, _logits = self.engine.prefill(
+                    idx, req.prompt, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p)
+                act = _ActiveSlot(req, submit_t, queue_wait, order)
+                act.first_token(tok, time.perf_counter())
+                self.slots[idx] = act
+                self._check_finished(idx, self.engine.slot_lengths())
             n += 1
-            self._check_finished(idx, self.engine.slot_lengths())
         if n:
             self._m_queue_depth.set(len(self.waiting))
             self._m_occupancy.set(
                 sum(a is not None for a in self.slots))
         return n
 
+    def prefill_once(self) -> int:
+        """Advance every admitting slot by ONE chunk (the chunked-
+        prefill interleave).  A chunk that cannot map pages evicts the
+        max-unshared victim and retries.  Returns chunks run."""
+        n = 0
+        for idx, act in enumerate(self.slots):
+            if act is None or act.prefill_task is None:
+                continue
+            task = act.prefill_task
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    done = self.engine.prefill_step(task)
+                    break
+                except PagePoolExhausted:
+                    if not self._evict_for_pages(idx):
+                        done = None    # requester itself was retired
+                        break
+            if done is None:
+                continue
+            now = time.perf_counter()
+            self._m_prefill_chunk.observe(now - t0)
+            n += 1
+            if done:
+                act.prefill_task = None
+                act.first_token(task.first_token, now)
+                self._check_finished(idx, self.engine.slot_lengths())
+        return n
+
+    # -- decode ------------------------------------------------------------
+
     def decode_once(self) -> int:
-        """One batched decode iteration over the active slots; returns the
-        number of tokens appended to live requests."""
-        active = [a is not None for a in self.slots]
+        """One batched decode iteration over the active (fully-
+        prefilled) slots; returns the number of tokens appended to live
+        requests."""
+        def active_mask():
+            return [a is not None and a.prefill_task is None
+                    for a in self.slots]
+
+        active = active_mask()
         if not any(active):
             return 0
+        if self.engine.paged:
+            # pre-step page bookkeeping: every append needs a mapped
+            # private page; pool-dry evicts the max-unshared victim
+            while True:
+                blocked = self.engine.ensure_decode_ready(active)
+                if blocked is None:
+                    break
+                self._evict_for_pages(blocked)
+                active = active_mask()
+                if not any(active):
+                    return 0
         S = self.engine.num_slots
         tokens = np.zeros((S,), np.int32)
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.ones((S,), np.float32)
         for i, act in enumerate(self.slots):
-            if act is None:
+            if not active[i]:
                 continue
             tokens[i] = act.generated[-1]
             temps[i] = act.req.temperature
@@ -202,15 +418,17 @@ class ContinuousBatchingScheduler:
             top_ps[i] = act.req.top_p
         t0 = time.perf_counter()
         next_tok, _logits = self.engine.decode(tokens, active, temps,
-                                               top_ks, top_ps)
+                                               top_ks, top_ps,
+                                               pages_ready=True)
         t1 = time.perf_counter()
-        lengths = self.engine.slot_lengths()   # ONE host copy per step
+        lengths = self.engine.slot_lengths()   # ONE fetch per step
         n = 0
         for i, act in enumerate(self.slots):
-            if act is None:
+            if not active[i]:
                 continue
             act.generated.append(int(next_tok[i]))
             act.decode_s += t1 - t0
+            act.decode_steps += 1
             act.last_t = t1
             n += 1
             self._check_finished(i, lengths)
@@ -222,19 +440,24 @@ class ContinuousBatchingScheduler:
         return n
 
     def step(self) -> int:
-        """One scheduler iteration: admit into free slots, then one
-        batched decode.  Returns tokens produced (prefill first-tokens
-        excluded)."""
+        """One scheduler iteration: admit into free slots, advance every
+        admitting slot by one prefill chunk, then one batched decode.
+        Returns decode tokens produced (prefill first-tokens excluded)."""
         self.admit()
+        self.prefill_once()
         return self.decode_once()
 
     def run(self) -> Dict[int, RequestResult]:
         """Drive to completion; returns {rid: RequestResult}.  Always
         terminates: with work pending, admit() either fills a free slot
-        or all slots are active, and then decode_once() appends a token
-        to every active request, each of which is finite (max_new_tokens
-        / max_len eviction)."""
+        or all slots are occupied; prefill_once() advances every
+        admitting prompt by one (finite) chunk — evicting on page
+        pressure rather than blocking — and decode_once() appends a
+        token to every active request, each of which is finite
+        (max_new_tokens / max_len eviction).  Preemption cannot spin
+        forever: each request is requeued at most ``max_preemptions``
+        times before it finishes "cache_full", and a requester that is
+        the sole occupant is finished, never requeued."""
         while self.waiting or any(a is not None for a in self.slots):
-            self.admit()
-            self.decode_once()
+            self.step()
         return self.finished

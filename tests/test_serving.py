@@ -162,7 +162,8 @@ def test_decode_compiles_once_across_32_tokens_and_slot_churn():
     assert total >= 32
     assert eng.decode_compile_count == 1, \
         "decode retraced: %d programs" % eng.decode_compile_count
-    assert eng.prefill_compile_count <= len(eng.buckets)
+    # paged (default) engines run ONE chunked-prefill program, full stop
+    assert eng.prefill_compile_count == 1
 
 
 def test_decode_step_hlo_has_no_s64_compute():
@@ -213,7 +214,10 @@ def test_scheduler_admission_is_fifo():
     assert active == rids[:2]              # first two submitted, in order
     assert [r.rid for r in sched.waiting] == rids[2:]
     # drain one slot -> the NEXT waiting request (rids[2]) takes it
+    # (paged admissions stay `prefilling` until their chunks run, so
+    # the drive loop must advance prefill too — step() without admit)
     while sched.slots[0] is not None or sched.slots[1] is not None:
+        sched.prefill_once()
         sched.decode_once()
         if any(a is None for a in sched.slots):
             break
@@ -223,7 +227,9 @@ def test_scheduler_admission_is_fifo():
 
 
 def test_prefill_bucket_selection():
-    eng = _engine(num_slots=1, max_len=64, min_bucket=16)
+    # bucketed prefill is the SLOTTED path (paged engines compile one
+    # chunk program instead — tests/test_paged.py)
+    eng = _engine(num_slots=1, max_len=64, min_bucket=16, paged=False)
     assert eng.buckets == [16, 32, 64]
     assert eng.bucket_for(1) == 16
     assert eng.bucket_for(16) == 16
@@ -233,7 +239,7 @@ def test_prefill_bucket_selection():
         eng.bucket_for(65)
     # distinct buckets = distinct compiles; repeats hit the jit cache
     rng = np.random.default_rng(0)
-    eng2 = _engine(num_slots=1, max_len=64)
+    eng2 = _engine(num_slots=1, max_len=64, paged=False)
     for n in (4, 10, 16):                  # all bucket 16
         eng2.prefill(0, rng.integers(0, 512, (n,)))
     assert eng2.prefill_compile_count == 1
@@ -280,7 +286,11 @@ def test_scheduler_eviction_on_cache_full():
     # decodes and the final sampled token is never written: the request
     # carries (16 - 5) + 1 generated tokens
     assert res[rid].tokens.size == 16 - 5 + 1
-    assert int(eng.slot_lengths()[0]) == 16
+    # retirement frees the slot: its pages return to the pool and the
+    # host length zeroes (slotted engines used to leave the stale
+    # length; the paged allocator reclaims eagerly)
+    assert int(eng.slot_lengths()[0]) == 0
+    assert eng.pages_free() == eng.num_pages
 
 
 def test_scheduler_reports_ttft_tpot():
@@ -491,7 +501,7 @@ def test_non_power_of_two_max_len_gets_a_final_bucket():
     from paddle_tpu.serving.engine import prefill_buckets_for
     assert prefill_buckets_for(100) == [16, 32, 64, 100]
     assert prefill_buckets_for(64) == [16, 32, 64]
-    eng = _engine(num_slots=1, max_len=48, min_bucket=16)
+    eng = _engine(num_slots=1, max_len=48, min_bucket=16, paged=False)
     assert eng.buckets == [16, 32, 48]
     assert eng.bucket_for(40) == 48       # fits the cache -> admissible
     tok, _ = eng.prefill(0, np.arange(1, 41, dtype=np.int32))

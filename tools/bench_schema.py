@@ -26,6 +26,26 @@ count for ENTRY to be exactly 1 — the CI smoke gate that replaced
 bench_decode's ad-hoc assert (the watchdog also enforces it at runtime
 under PADDLE_TPU_STRICT_COMPILE=1; this checks the *reported* line).
 
+**Trajectory mode (ISSUE 7 / ROADMAP item 5 payoff).**  ``--trajectory``
+promotes the loose ``BENCH_r*`` / ``BENCH_decode_*`` wrapper files into
+one schema'd, *gated* series: every wrapper is validated, grouped by
+metric into ordered series (round order = sorted filename), and two
+gates run over each series —
+
+* **compile counts, every backend**: any entry that reports
+  ``compile_counts``/``metrics.compile_counts`` must satisfy the
+  compile-once contract for the decode entry (``serving.decode == 1``;
+  the CPU CI run is exactly as able to catch a retrace as a chip run —
+  program-cache sizes don't depend on the backend);
+* **on-chip regression**: between CONSECUTIVE entries of one series
+  whose ``config.backend == "tpu"`` (same model), a >3% drop in
+  ``value`` fails.  CPU entries never perf-gate (smoke numbers), so the
+  gate arms itself automatically the first session that records chip
+  numbers.
+
+``--trajectory --write OUT`` additionally emits the assembled series as
+one JSON document (the trajectory file CI archives).
+
 Exit 0 = every input valid.  No third-party deps (hand-rolled checks:
 the CI image has no jsonschema).
 """
@@ -125,27 +145,132 @@ def validate_wrapper(doc: Any, path: str,
         _require(doc["rc"] == 0, path,
                  "bench exited rc=%r — a failed run must not enter the "
                  "trajectory" % (doc["rc"],))
-    parsed = doc.get("parsed")
-    if parsed is None:
-        # driver could not parse a line: last resort, find one in tail
-        for raw in reversed(doc.get("tail", "").splitlines()):
-            raw = raw.strip()
-            if raw.startswith("{"):
-                parsed = json.loads(raw)
-                break
-        _require(parsed is not None, path,
-                 "no JSON line found in wrapper 'tail'")
+    parsed = _extract_line(doc, path)
     validate_line(parsed, path + ":parsed", expect_compile_once)
+    return parsed
+
+
+def validate_doc(doc: Any, path: str, expect_compile_once: List[str] = ()):
+    """Validate an already-loaded document (wrapper file or raw line);
+    returns the bench line inside (the doc itself when raw)."""
+    if isinstance(doc, dict) and ("parsed" in doc or "cmd" in doc
+                                  or "tail" in doc):
+        return validate_wrapper(doc, path, expect_compile_once)
+    validate_line(doc, path, expect_compile_once)
+    return doc
 
 
 def validate_path(path: str, expect_compile_once: List[str] = ()):
     with open(path) as f:
         doc = json.load(f)
+    validate_doc(doc, path, expect_compile_once)
+
+
+def _extract_line(doc: Any, path: str) -> Any:
+    """The bench JSON line inside a wrapper (or the doc itself)."""
     if isinstance(doc, dict) and ("parsed" in doc or "cmd" in doc
                                   or "tail" in doc):
-        validate_wrapper(doc, path, expect_compile_once)
-    else:
-        validate_line(doc, path, expect_compile_once)
+        parsed = doc.get("parsed")
+        if parsed is None:
+            for raw in reversed(doc.get("tail", "").splitlines()):
+                raw = raw.strip()
+                if raw.startswith("{"):
+                    parsed = json.loads(raw)
+                    break
+        _require(parsed is not None, path,
+                 "no JSON line found in wrapper 'tail'")
+        return parsed
+    return doc
+
+
+# the compile-once contract per metric series: which watchdog entry (or
+# legacy top-level compile_counts key) must be exactly 1 whenever the
+# line reports compile accounting at all
+_COMPILE_ONCE = {
+    "decode_tokens_per_sec": (("metrics", "serving.decode"),
+                              ("top", "decode")),
+}
+
+REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
+
+
+def check_trajectory(paths: List[str], write: str = None) -> List[str]:
+    """Validate + gate the ordered BENCH_* series; returns failures."""
+    failures: List[str] = []
+    series: dict = {}
+    for p in sorted(paths):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            line = validate_doc(doc, p)
+        except (SchemaError, json.JSONDecodeError, OSError) as e:
+            failures.append(str(e) if isinstance(e, SchemaError)
+                            else "%s: %s" % (p, e))
+            continue
+        cfg = line.get("config", {}) if isinstance(
+            line.get("config"), dict) else {}
+        entry = {
+            "file": p,
+            "metric": line.get("metric"),
+            "value": line.get("value"),
+            "unit": line.get("unit"),
+            "backend": cfg.get("backend"),
+            "model": cfg.get("model"),
+            "cache_layout": line.get("cache_layout"),
+            "compile_counts": (line.get("metrics", {}) or {}).get(
+                "compile_counts", line.get("compile_counts")),
+        }
+        series.setdefault(entry["metric"], []).append(entry)
+
+        # gate 1 — compile counts (ANY backend: the jit cache size a CPU
+        # run reports catches a retrace exactly as well as a chip run)
+        for kind, key in _COMPILE_ONCE.get(entry["metric"], ()):
+            cc = ((line.get("metrics") or {}).get("compile_counts")
+                  if kind == "metrics" else line.get("compile_counts"))
+            if cc is None or key not in cc:
+                continue
+            if cc[key] != 1:
+                failures.append(
+                    "%s: compile-once violated — %s compile count for "
+                    "%r is %r, expected exactly 1" % (p, kind, key,
+                                                      cc[key]))
+
+    # gate 2 — on-chip regression between consecutive chip entries.
+    # One cursor per (model, cache_layout) within each metric: a series
+    # that interleaves layouts (bench_decode --both emits paged AND
+    # slotted lines per round) still compares like-for-like — a single
+    # cursor would skip every comparison AND lose its anchor, leaving
+    # the gate silently inert.
+    for metric, entries in series.items():
+        prev_by_key = {}
+        for e in entries:
+            if e["backend"] != "tpu":
+                continue
+            key = (e.get("model"), e.get("cache_layout"))
+            prev = prev_by_key.get(key)
+            if (prev is not None and _is_num(e["value"])
+                    and _is_num(prev["value"]) and prev["value"] > 0):
+                drop = 1.0 - e["value"] / prev["value"]
+                if drop > REGRESSION_TOLERANCE:
+                    failures.append(
+                        "%s: on-chip regression — %s fell %.1f%% vs %s "
+                        "(%.2f -> %.2f; tolerance %.0f%%)"
+                        % (e["file"], metric, 100 * drop, prev["file"],
+                           prev["value"], e["value"],
+                           100 * REGRESSION_TOLERANCE))
+            prev_by_key[key] = e
+
+    if write and not failures:
+        out = {"schema": 1, "tolerance": REGRESSION_TOLERANCE,
+               "series": series}
+        with open(write, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+    for metric, entries in sorted(series.items()):
+        chip = sum(1 for e in entries if e["backend"] == "tpu")
+        print("trajectory %r: %d entries (%d on-chip)"
+              % (metric, len(entries), chip))
+    return failures
 
 
 def main(argv=None) -> int:
@@ -160,7 +285,23 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-compile-once", action="append", default=[],
                     metavar="ENTRY",
                     help="require metrics.compile_counts[ENTRY] == 1")
+    ap.add_argument("--trajectory", action="store_true",
+                    help="series mode: validate the ordered BENCH_r*/"
+                         "BENCH_decode_* trajectory, assert compile "
+                         "counts on every backend, fail on >3%% on-chip "
+                         "regression between consecutive chip entries")
+    ap.add_argument("--write", default=None, metavar="OUT",
+                    help="with --trajectory: write the assembled series "
+                         "document to OUT")
     args = ap.parse_args(argv)
+
+    if args.trajectory:
+        paths = args.paths or sorted(
+            glob.glob("BENCH_r*.json") + glob.glob("BENCH_decode_*.json"))
+        failures = check_trajectory(paths, write=args.write)
+        for f in failures:
+            print("TRAJECTORY ERROR — %s" % f, file=sys.stderr)
+        return 1 if failures else 0
 
     failures = []
     try:
