@@ -1,41 +1,58 @@
 """Benchmark: serving decode throughput through the compiled engine.
 
-Prints ONE JSON line (the BENCH_decode_* trajectory format, next to the
-training one from bench.py):
+Prints ONE JSON line per configuration (the BENCH_decode_* trajectory
+format, next to the training one from bench.py):
 
   {"metric": "decode_tokens_per_sec", "value": N, "unit": "tok/s",
    "ttft_ms": ..., "tpot_ms": ..., "kv_bytes_per_token": {...},
+   "cache_layout": ..., "kv_dtype": ..., "spec": ...,
    "compile_counts": {...}, ...}
 
 Protocol: submit `requests` prompts through the continuous-batching
 scheduler at `num_slots` concurrency and time the full drain.  Decode
 throughput counts every generated token (first tokens, which are
 prefill work, are reported separately via TTFT).  `compile_counts`
-asserts the structural claim this engine exists for: the decode step
-compiles EXACTLY ONCE no matter how many tokens are generated, how
-slots churn, how many admissions hit the prefix cache, or how many
-chunked prefills interleave — enforced by the recompile watchdog
+asserts the structural claim this engine exists for: the decode-side
+step (plain decode, or the speculative verify) compiles EXACTLY ONCE no
+matter how many tokens are generated, how slots churn, how many
+admissions hit the prefix cache, how many chunked prefills interleave,
+or what the draft accept rate does — enforced by the recompile watchdog
 (paddle_tpu.observability.watchdog), which this bench arms in STRICT
 mode so any retrace raises at the step that caused it instead of being
 discovered in a summary line.  The `metrics` block carries p50/p95/p99
 TTFT/TPOT/queue-wait from the histogram registry (reset after warmup so
 percentiles describe the timed drain only).
 
-Cache layout (ISSUE 7): `--paged` (the default) runs the page-pool
-engine — chunked prefill, prefix sharing, paged-gather attention — and
-reports `kv_bytes_per_token`, the measured A/B of the decode KV read
-bound: `paged` is what a length-aware paged schedule reads (each slot's
-MAPPED pages), `flat` is the slotted `slots*max_len` bound.  A third of
-the workload reuses one shared prompt so the prefix cache actually
-exercises (`prefix_hit_pages` in the line).  `--slotted` runs the PR-5
-layout for the A/B baseline; `--both` emits two JSON lines.
+A/B axes (ISSUE 7 + ISSUE 8 — the cartesian product of the three flags
+below runs as one matrix, one JSON line each):
+
+* `--paged` (default) / `--slotted` / `--both` — cache layout.  Paged
+  reports `kv_bytes_per_token` {paged: mapped-rows bound, flat: the
+  slotted slots*max_len bound}; a third of the workload reuses one
+  shared prompt so prefix sharing/CoW stay on the timed path.
+* `--kv-dtype bf16|int8` (comma list for a sweep) — int8 stores the KV
+  pool as int8 codes + per-(row, head) f32 scales, HALVING the decode
+  read bound at head_dim 64 ((64+4)/(2*64) = 0.53x the bf16 row — the
+  acceptance line; the accounting charges the scale reads honestly).
+* `--spec k|off` (comma list) — self-speculative decode: k prompt-lookup
+  drafts per slot per iteration, one batched verify program.  Emits
+  `accepted_tokens_per_step` (accepted drafts per verify iteration,
+  summed over active slots — the extra tokens each program launch
+  commits beyond the batch's baseline one-per-slot) and
+  `spec_accept_rate` (accepted/proposed); the paged KV read is
+  amortized over every committed token, so `kv_bytes_per_token.paged`
+  drops with the accept rate — the second multiplicative lever on the
+  same bandwidth wall.
 
 On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
-On CPU: the tiny config, so the bench always runs (numbers are smoke
-only).  Knobs: PADDLE_TPU_BENCH_SLOTS / _PROMPT / _NEW / _REQUESTS.
+On CPU: a tiny head_dim-64 config (`tiny_d64`), so the bench always
+runs AND the int8 scale-overhead ratio matches real head dims (numbers
+are smoke only).  Knobs: PADDLE_TPU_BENCH_SLOTS / _PROMPT / _NEW /
+_REQUESTS.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -44,7 +61,7 @@ import time
 import numpy as np
 
 
-def run_layout(paged: bool):
+def run_config(paged: bool, kv_dtype: str, spec: int):
     import jax
 
     import paddle_tpu as paddle
@@ -58,11 +75,16 @@ def run_layout(paged: bool):
 
     if on_tpu:
         cfg = GPTConfig.gpt2_medium()
+        model_name = "gpt2_345m"
         num_slots, prompt_len, max_new, requests = 8, 128, 128, 24
         max_len, page_size = 1024, 64
-    else:  # CPU smoke config so bench_decode.py always runs
-        cfg = GPTConfig.tiny()
-        num_slots, prompt_len, max_new, requests = 4, 12, 16, 8
+    else:  # CPU smoke config so bench_decode.py always runs; head_dim 64
+        # so the int8 row ratio ((d+4)/(2d)) matches serving head dims
+        cfg = GPTConfig(vocab_size=512, max_position_embeddings=256,
+                        hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=2, intermediate_size=256)
+        model_name = "tiny_d64"
+        num_slots, prompt_len, max_new, requests = 4, 24, 16, 8
         max_len, page_size = 128, 16
     num_slots = int(os.getenv("PADDLE_TPU_BENCH_SLOTS", num_slots))
     prompt_len = int(os.getenv("PADDLE_TPU_BENCH_PROMPT", prompt_len))
@@ -77,7 +99,10 @@ def run_layout(paged: bool):
     model.eval()
 
     engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
-                          seed=0, paged=paged, page_size=page_size)
+                          seed=0, paged=paged, page_size=page_size,
+                          kv_dtype=("int8" if kv_dtype == "int8"
+                                    else None),
+                          spec_k=spec)
     rng = np.random.default_rng(0)
     # one shared "system prompt" a third of the requests reuse — the
     # prefix-sharing path must be ON the timed path, not a dead feature
@@ -104,9 +129,9 @@ def run_layout(paged: bool):
         return results, time.perf_counter() - t0
 
     # warmup drain: compiles prefill (one chunk program / one bucket) +
-    # the decode step once
+    # the decode-side step (decode, or the speculative verify) once
     drive(min(num_slots, requests))
-    engine.reset()      # pages/slots back + kv_stats re-zeroed
+    engine.reset()      # pages/slots back + kv/spec stats re-zeroed
     # percentiles must describe the TIMED drain, not the compile-heavy
     # warmup — drop warmup samples.  reset() also zeroes the registry's
     # compile.count shadow of the watchdog (whose ground truth, the jit
@@ -141,19 +166,27 @@ def run_layout(paged: bool):
         "total_tokens": total_tokens,
         "wall_s": round(dt, 3),
         "cache_layout": "paged" if paged else "slotted",
-        # the ISSUE-7 acceptance line: decode KV bytes read per
+        # trajectory cursor keys (bench_schema gates like-for-like
+        # series): the quantization and speculation axes
+        "kv_dtype": kv_dtype,
+        "spec": spec,
+        # the ISSUE-7/8 acceptance line: decode KV bytes read per
         # generated token — `paged` scales with TRUE lengths (mapped
-        # pages), `flat` is the slotted slots*max_len bound the paged
-        # layout replaces
+        # pages, amortized over every spec-committed token), `flat` is
+        # the slotted slots*max_len bound; int8 halves the per-row cost
+        # (codes + scales accounted)
         "kv_bytes_per_token": {k: round(v, 1) for k, v in kv.items()},
         "prefix_hit_tokens": prefix_hit_tokens,
         # compile accounting now comes from the recompile watchdog (which
         # also enforces the budget at runtime — strict mode); the engine
-        # properties remain as a cross-check
-        "compile_counts": {
+        # properties remain as a cross-check.  Zero-count entries (the
+        # single-token decode in a pure-spec drain) are omitted: a
+        # reported entry must have compiled (schema contract).
+        "compile_counts": {k: v for k, v in {
             "decode": engine.decode_compile_count,
+            "verify": engine.verify_compile_count,
             "prefill": engine.prefill_compile_count,
-        },
+        }.items() if v > 0},
         "metrics": {
             "histograms": {
                 "serving.ttft_seconds": _pcts("serving.ttft_seconds"),
@@ -163,10 +196,11 @@ def run_layout(paged: bool):
                 "serving.decode_step_seconds":
                     _pcts("serving.decode_step_seconds"),
             },
-            "compile_counts": obs.compile_counts(),
+            "compile_counts": {k: v for k, v in
+                               obs.compile_counts().items() if v > 0},
         },
         "config": {
-            "model": "gpt2_345m" if on_tpu else "tiny",
+            "model": model_name,
             "backend": jax.default_backend(),
             "num_slots": num_slots, "max_len": max_len,
             "prompt_len": prompt_len, "max_new_tokens": max_new,
@@ -177,25 +211,73 @@ def run_layout(paged: bool):
         },
         "autotune": at.report(),
     }
+    if spec:
+        st = engine.spec_stats
+        result["accepted_tokens_per_step"] = round(
+            st["accepted"] / max(st["steps"], 1), 3)
+        result["spec_accept_rate"] = round(
+            st["accepted"] / max(st["proposed"], 1), 4)
     print(json.dumps(result))
     sys.stdout.flush()
 
 
 def main(argv=None):
     # the watchdog IS the compile-count gate: any recompile of a watched
-    # entry (serving.decode budget: 1) raises RecompileError mid-drain
+    # entry (serving.decode / serving.spec_verify budget: 1) raises
+    # RecompileError mid-drain
     os.environ.setdefault("PADDLE_TPU_STRICT_COMPILE", "1")
-    argv = sys.argv[1:] if argv is None else argv
-    if "--both" in argv:
-        layouts = [True, False]
-    elif "--slotted" in argv:
-        layouts = [False]
-    else:                          # --paged is the default
-        layouts = [True]
-    for paged in layouts:
-        # run_layout resets the registry and resyncs the watchdog after
-        # its own warmup drain, so no inter-layout state scrub is needed
-        run_layout(paged)
+    ap = argparse.ArgumentParser(
+        prog="python bench_decode.py",
+        description="serving decode benchmark (A/B matrix over cache "
+                    "layout x kv dtype x speculative k)")
+    ap.add_argument("--paged", action="store_true",
+                    help="page-pool engine (the default)")
+    ap.add_argument("--slotted", action="store_true",
+                    help="PR-5 slotted layout (the A/B baseline)")
+    ap.add_argument("--both", action="store_true",
+                    help="paged AND slotted lines")
+    ap.add_argument("--kv-dtype", default="bf16",
+                    help="comma list of bf16|int8 (bf16 = the "
+                         "unquantized pool at the activation dtype)")
+    ap.add_argument("--spec", default="off",
+                    help="comma list of off|<k>: speculative draft "
+                         "length per iteration (paged only)")
+    args = ap.parse_args(argv)
+
+    layouts = ([True, False] if args.both
+               else [False] if args.slotted else [True])
+    kv_dtypes = []
+    for tok in str(args.kv_dtype).split(","):
+        tok = tok.strip().lower()
+        if tok not in ("bf16", "int8"):
+            ap.error("--kv-dtype values must be bf16 or int8, got %r"
+                     % tok)
+        kv_dtypes.append(tok)
+    specs = []
+    for tok in str(args.spec).split(","):
+        tok = tok.strip().lower()
+        if tok in ("off", "0"):
+            specs.append(0)
+        elif tok.isdigit() and int(tok) > 0:
+            specs.append(int(tok))
+        else:
+            ap.error("--spec values must be 'off' or a positive draft "
+                     "length, got %r" % tok)
+
+    configs = [(paged, kv_dtype, spec)
+               for paged in layouts
+               for kv_dtype in kv_dtypes
+               for spec in specs
+               if not (spec and not paged)]   # speculation is paged-only
+    if not configs:
+        # e.g. --slotted --spec 4: silently emitting ZERO lines would
+        # make a CI pipe fail later with an opaque empty-stdin error
+        ap.error("no runnable configuration: speculative decode "
+                 "(--spec > 0) needs the paged layout")
+    for paged, kv_dtype, spec in configs:
+        # run_config resets the registry and resyncs the watchdog after
+        # its own warmup drain, so no inter-config state scrub is needed
+        run_config(paged, kv_dtype, spec)
 
 
 if __name__ == "__main__":

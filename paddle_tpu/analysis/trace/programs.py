@@ -205,9 +205,14 @@ def _build_serving() -> List[TraceProgram]:
       chunked-prefill program) and ``serving/cow_copy`` (the page
       copy-on-write step, both pool buffers donated);
     * slotted (kept for A/B) — ``serving/decode_step_slotted`` and
-      ``serving/prefill`` (the smallest bucket)."""
+      ``serving/prefill`` (the smallest bucket);
+    * ISSUE 8 modes, COMPOSED (int8 KV + speculative) so the audit
+      covers the quantized scatter/gather and the in-program
+      accept/rollback — ``serving/spec_verify`` (the batched k+1-token
+      verify over the int8 pool; code AND scale pools donated) and
+      ``serving/decode_step_q8`` (the single-token fallback on the same
+      engine)."""
     import jax
-    import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
@@ -217,8 +222,8 @@ def _build_serving() -> List[TraceProgram]:
     model = GPTForCausalLM(GPTConfig.tiny())
     paged = DecodeEngine(model, num_slots=2, max_len=64, page_size=16)
     slotted = DecodeEngine(model, num_slots=2, max_len=64, paged=False)
-    cow_args = (paged.cache.k, paged.cache.v, jnp.zeros((), jnp.int32),
-                jnp.ones((), jnp.int32))
+    spec_q8 = DecodeEngine(model, num_slots=2, max_len=64, page_size=16,
+                           spec_k=4, kv_dtype="int8")
     out: List[TraceProgram] = []
     for name, fn, donate, args in (
             ("serving/decode_step", paged._decode_fn,
@@ -227,12 +232,18 @@ def _build_serving() -> List[TraceProgram]:
              paged._prefill_chunk_donate_argnums,
              paged.prefill_chunk_trace_args()),
             ("serving/cow_copy", paged._cow_fn,
-             paged._cow_donate_argnums, cow_args),
+             paged._cow_donate_argnums, paged.cow_trace_args()),
             ("serving/decode_step_slotted", slotted._decode_fn,
              slotted._decode_donate_argnums, slotted.decode_trace_args()),
             ("serving/prefill", slotted._prefill_fn,
              slotted._prefill_donate_argnums,
-             slotted.prefill_trace_args())):
+             slotted.prefill_trace_args()),
+            ("serving/spec_verify", spec_q8._verify_fn,
+             spec_q8._verify_donate_argnums,
+             spec_q8.verify_trace_args()),
+            ("serving/decode_step_q8", spec_q8._decode_fn,
+             spec_q8._decode_donate_argnums,
+             spec_q8.decode_trace_args())):
         # keep_unused=True for the AUDIT wrap only (same rationale as the
         # train step): pruning would misalign the entry's argument
         # indices against the jaxpr's donation flags.  x64_scope(False)

@@ -502,6 +502,15 @@ def standard_keys() -> List[tuple]:
     out.append(("decode_attn_paged", dat.paged_autotune_key(
         slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
         qlen=1, dtype=dtype)))
+    # int8 KV (ISSUE 8): the q8 gather schedules tune under their own
+    # key, and the speculative verify shape (qlen = k+1) tunes the
+    # multi-token masked path the verify program runs
+    out.append(("decode_attn_paged", dat.paged_autotune_key(
+        slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
+        qlen=1, dtype=dtype, kv_dtype="int8")))
+    out.append(("decode_attn_paged", dat.paged_autotune_key(
+        slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
+        qlen=5, dtype=dtype)))
     return out
 
 

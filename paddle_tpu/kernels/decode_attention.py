@@ -31,10 +31,23 @@ the *schedule*:
   ``max_len`` where the one-shot buffers stop fitting close to the
   compute.
 
+**int8 KV (ISSUE 8)** — when the cache pool is int8 codes + per-(row,
+head) f32 scales (``serving.cache`` ``kv_dtype="int8"``), the q8
+variants — ``masked_q8``/``chunked_q8`` (slotted) and
+``paged_gather_q8``/``paged_chunked_q8`` (paged) — **dequantize inline
+in the gather**: the HBM read moves int8 codes (+ one f32 scale per
+row-head, ~6% at head_dim 64), i.e. roughly HALF the bf16 pool's
+bytes, and the dequantized values exist only as a fused compute-local
+intermediate.  The autotune key gains ``kv_dtype`` so quantized and
+unquantized schedules tune independently.
+
 All variants keep the bf16-region dtype discipline TPU501 audits:
 ``dot_general`` runs on the input dtype with ``preferred_element_type``
 f32 accumulation, the softmax statistic chain stays f32, and ``p`` is
-cast back to the input dtype before the second matmul.
+cast back to the input dtype before the second matmul.  The q8 dequant
+multiplies int8->f32-converted codes by f32 scales and casts ONCE to
+the compute dtype — no bf16->f32 upcast, so the bf16-region audit stays
+clean by construction.
 """
 from __future__ import annotations
 
@@ -45,16 +58,52 @@ import jax.numpy as jnp
 
 __all__ = ["decode_attention", "paged_decode_attention", "autotune_key",
            "paged_autotune_key", "supported_block_ts",
-           "supported_pages_per_block"]
+           "supported_pages_per_block", "quantize_kv", "dequantize_kv"]
 
 _NEG_INF = -1e30
 
+# -- int8 KV grid (the ONE canonical definition — serving.cache imports
+#    these, and the autotune runners synthesize operands through the same
+#    math, so the grid can never drift between the cache's writes and the
+#    kernels' reads; for an fp8/e4m3 pool only _Q_MAX and the code dtype
+#    change) -----------------------------------------------------------
 
-def autotune_key(slots, t, h, d, qlen, dtype):
+_Q_MAX = 127.0
+
+
+def quantize_kv(x):
+    """Quantize ``x: (..., heads, head_dim)`` to int8 codes + per-(...,
+    head) f32 scales (symmetric amax/127).  The clip is belt-and-braces:
+    ``|x| <= amax`` bounds ``x/scale`` at 127 up to one f32 rounding."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, jnp.asarray(1e-30, jnp.float32)) / _Q_MAX
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -_Q_MAX, _Q_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(codes, scales, dtype):
+    """Inverse of :func:`quantize_kv` in the given compute dtype.  The
+    multiply runs f32 (int8->f32 is exact; the single trailing cast to
+    bf16 rounds below the quantization error) — TPU501-clean: no
+    bf16->f32 upcast is involved."""
+    return (codes.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+def autotune_key(slots, t, h, d, qlen, dtype, kv_dtype=None):
     from . import autotune as at
-    return {"slots": int(slots), "t": int(t), "h": int(h), "d": int(d),
-            "qlen": int(qlen), "dtype": str(jnp.dtype(dtype)),
-            "platform": at.platform()}
+    key = {"slots": int(slots), "t": int(t), "h": int(h), "d": int(d),
+           "qlen": int(qlen), "dtype": str(jnp.dtype(dtype)),
+           "platform": at.platform()}
+    if kv_dtype is not None:
+        # only quantized keys carry the field: unquantized keys (and any
+        # persisted cache entries for them) stay byte-identical to PR 7's
+        key["kv_dtype"] = str(jnp.dtype(kv_dtype))
+    return key
+
+
+# dequantize-inline shorthand for the q8 variants below
+_deq = dequantize_kv
 
 
 def _scale(scale, d):
@@ -143,14 +192,62 @@ def supported_block_ts(t):
     return [bt for bt in (128, 256, 512) if t % bt == 0 and bt < t]
 
 
+def _masked_q8(q, k8, ks, v8, vs, pos, scale):
+    """One-shot over the int8 slotted cache: dequantize the (slots, T)
+    rows inline (the HBM read is the int8 codes + scale rows) and run
+    the masked softmax."""
+    return _masked(q, _deq(k8, ks, q.dtype), _deq(v8, vs, q.dtype),
+                   pos, scale)
+
+
+def _chunked_q8(q, k8, ks, v8, vs, pos, scale, block_t):
+    """Online-softmax over int8 key chunks: each scan step dequantizes
+    ONE block, so the dequantized working set is O(block_t)."""
+    b, s, h, d = q.shape
+    t = k8.shape[1]
+    n_chunks = t // block_t
+    sc = _scale(scale, d)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    kc = jnp.moveaxis(k8.reshape(b, n_chunks, block_t, h, d), 1, 0)
+    vc = jnp.moveaxis(v8.reshape(b, n_chunks, block_t, h, d), 1, 0)
+    ksc = jnp.moveaxis(ks.reshape(b, n_chunks, block_t, h), 1, 0)
+    vsc = jnp.moveaxis(vs.reshape(b, n_chunks, block_t, h), 1, 0)
+
+    def body(carry, xs):
+        k_blk, v_blk, ks_blk, vs_blk, c = xs
+        t_ids = c * block_t + jnp.arange(block_t, dtype=jnp.int32)
+        return _online_step(carry, q, _deq(k_blk, ks_blk, q.dtype),
+                            _deq(v_blk, vs_blk, q.dtype), t_ids, q_pos,
+                            sc), None
+
+    chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+    carry, _ = jax.lax.scan(body, _online_init(b, h, s, d),
+                            (kc, vc, ksc, vsc, chunk_ids))
+    return _online_finish(carry, q.dtype)
+
+
 def _candidates(key):
+    if key.get("kv_dtype") == "int8":
+        out = [{"variant": "masked_q8", "config": {}}]
+        for bt in supported_block_ts(key["t"]):
+            out.append({"variant": "chunked_q8",
+                        "config": {"block_t": bt}})
+        return out
     out = [{"variant": "masked", "config": {}}]
     for bt in supported_block_ts(key["t"]):
         out.append({"variant": "chunked", "config": {"block_t": bt}})
     return out
 
 
-def _dispatch(cand, q, k, v, pos, scale):
+def _dispatch(cand, q, k, v, pos, scale, k_scales=None, v_scales=None):
+    if k_scales is not None:
+        if cand.get("variant") == "chunked_q8":
+            bt = int(cand.get("config", {}).get("block_t", 0))
+            if bt > 0 and k.shape[1] % bt == 0:
+                return _chunked_q8(q, k, k_scales, v, v_scales, pos,
+                                   scale, bt)
+            # invalid cached/pinned config: fall back, never fault
+        return _masked_q8(q, k, k_scales, v, v_scales, pos, scale)
     if cand.get("variant") == "chunked":
         bt = int(cand.get("config", {}).get("block_t", 0))
         if bt > 0 and k.shape[1] % bt == 0:
@@ -159,19 +256,24 @@ def _dispatch(cand, q, k, v, pos, scale):
     return _masked(q, k, v, pos, scale)
 
 
-def decode_attention(q, k, v, lengths, scale=None):
+def decode_attention(q, k, v, lengths, scale=None, k_scales=None,
+                     v_scales=None):
     """Length-masked attention for the slotted decode step (raw arrays).
 
     q: (slots, s, heads, d); k/v: (slots, max_len, heads, d);
     lengths: (slots,) int32 — each slot's PRE-append valid length (the new
     rows were already written at [lengths, lengths+s), so query offset j
-    attends keys t <= lengths + j).
+    attends keys t <= lengths + j).  For the int8 cache, k/v are the code
+    arrays and ``k_scales/v_scales: (slots, max_len, heads)`` f32 select
+    the q8 variants (dequantize inline).
     """
     from . import autotune as at
+    kv_dtype = None if k_scales is None else k.dtype
     key = autotune_key(q.shape[0], k.shape[1], q.shape[2], q.shape[3],
-                       q.shape[1], q.dtype)
+                       q.shape[1], q.dtype, kv_dtype=kv_dtype)
     cand = at.resolve("decode_attn", key)
-    return _dispatch(cand, q, k, v, lengths, scale)
+    return _dispatch(cand, q, k, v, lengths, scale,
+                     k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------
@@ -180,12 +282,15 @@ def decode_attention(q, k, v, lengths, scale=None):
 
 
 def paged_autotune_key(slots, pages, page_size, max_pages, h, d, qlen,
-                       dtype):
+                       dtype, kv_dtype=None):
     from . import autotune as at
-    return {"slots": int(slots), "pages": int(pages),
-            "page_size": int(page_size), "max_pages": int(max_pages),
-            "h": int(h), "d": int(d), "qlen": int(qlen),
-            "dtype": str(jnp.dtype(dtype)), "platform": at.platform()}
+    key = {"slots": int(slots), "pages": int(pages),
+           "page_size": int(page_size), "max_pages": int(max_pages),
+           "h": int(h), "d": int(d), "qlen": int(qlen),
+           "dtype": str(jnp.dtype(dtype)), "platform": at.platform()}
+    if kv_dtype is not None:
+        key["kv_dtype"] = str(jnp.dtype(kv_dtype))
+    return key
 
 
 def _gather_pages(kp, table):
@@ -235,7 +340,63 @@ def supported_pages_per_block(max_pages):
     return [m for m in (1, 2, 4, 8) if max_pages % m == 0 and m < max_pages]
 
 
+def _gather_scale_pages(sp, table):
+    """sp: (num_pages, P, h) f32 scale pool; table: (B, n) int32 ->
+    (B, n*P, h) — the scale-row companion of :func:`_gather_pages`."""
+    b, n = table.shape
+    P, h = sp.shape[1], sp.shape[2]
+    return sp[table].reshape(b, n * P, h)
+
+
+def _paged_gather_q8(q, kp, ks, vp, vs, table, pos, scale):
+    """One-shot over the int8 pool: gather every mapped page's codes AND
+    scale rows, dequantize inline, then the masked softmax — the HBM
+    read is the int8 pages plus the (head_dim/4)x-smaller scale pages."""
+    return _masked(q,
+                   _deq(_gather_pages(kp, table),
+                        _gather_scale_pages(ks, table), q.dtype),
+                   _deq(_gather_pages(vp, table),
+                        _gather_scale_pages(vs, table), q.dtype),
+                   pos, scale)
+
+
+def _paged_chunked_q8(q, kp, ks, vp, vs, table, pos, scale,
+                      pages_per_block):
+    """Online-softmax over int8 page blocks: each scan step gathers and
+    dequantizes ``pages_per_block`` pages per slot — O(block)
+    dequantized working set."""
+    b, s, h, d = q.shape
+    P = int(kp.shape[1])
+    max_pages = int(table.shape[1])
+    m = int(pages_per_block)
+    n_chunks = max_pages // m
+    block = m * P
+    sc = _scale(scale, d)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    tb = jnp.moveaxis(table.reshape(b, n_chunks, m), 1, 0)  # (C, b, m)
+
+    def body(carry, xs):
+        ids, c = xs
+        k_blk = _deq(_gather_pages(kp, ids), _gather_scale_pages(ks, ids),
+                     q.dtype)
+        v_blk = _deq(_gather_pages(vp, ids), _gather_scale_pages(vs, ids),
+                     q.dtype)
+        t_ids = c * block + jnp.arange(block, dtype=jnp.int32)
+        return _online_step(carry, q, k_blk, v_blk, t_ids, q_pos, sc), None
+
+    chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+    carry, _ = jax.lax.scan(body, _online_init(b, h, s, d),
+                            (tb, chunk_ids))
+    return _online_finish(carry, q.dtype)
+
+
 def _paged_candidates(key):
+    if key.get("kv_dtype") == "int8":
+        out = [{"variant": "paged_gather_q8", "config": {}}]
+        for m in supported_pages_per_block(key["max_pages"]):
+            out.append({"variant": "paged_chunked_q8",
+                        "config": {"pages_per_block": m}})
+        return out
     out = [{"variant": "paged_gather", "config": {}}]
     for m in supported_pages_per_block(key["max_pages"]):
         out.append({"variant": "paged_chunked",
@@ -243,7 +404,17 @@ def _paged_candidates(key):
     return out
 
 
-def _dispatch_paged(cand, q, kp, vp, table, pos, scale):
+def _dispatch_paged(cand, q, kp, vp, table, pos, scale, k_scales=None,
+                    v_scales=None):
+    if k_scales is not None:
+        if cand.get("variant") == "paged_chunked_q8":
+            m = int(cand.get("config", {}).get("pages_per_block", 0))
+            if m > 0 and table.shape[1] % m == 0:
+                return _paged_chunked_q8(q, kp, k_scales, vp, v_scales,
+                                         table, pos, scale, m)
+            # invalid cached/pinned config: fall back, never fault
+        return _paged_gather_q8(q, kp, k_scales, vp, v_scales, table, pos,
+                                scale)
     if cand.get("variant") == "paged_chunked":
         m = int(cand.get("config", {}).get("pages_per_block", 0))
         if m > 0 and table.shape[1] % m == 0:
@@ -253,7 +424,7 @@ def _dispatch_paged(cand, q, kp, vp, table, pos, scale):
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           scale=None):
+                           scale=None, k_scales=None, v_scales=None):
     """Length-masked attention over one layer's page pool (raw arrays).
 
     q: (slots, s, heads, d); k_pages/v_pages: (num_pages, page_size,
@@ -261,15 +432,19 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     int32 — each slot's PRE-append valid length (the new rows were
     already scattered into the mapped pages, so query offset j attends
     keys t <= lengths + j; unmapped entries gather page 0 and are
-    masked).
+    masked).  For the int8 pool, k_pages/v_pages are code arrays and
+    ``k_scales/v_scales: (num_pages, page_size, heads)`` f32 select the
+    q8 variants (dequantize inline in the gather).
     """
     from . import autotune as at
+    kv_dtype = None if k_scales is None else k_pages.dtype
     key = paged_autotune_key(q.shape[0], k_pages.shape[0],
                              k_pages.shape[1], page_table.shape[1],
-                             q.shape[2], q.shape[3], q.shape[1], q.dtype)
+                             q.shape[2], q.shape[3], q.shape[1], q.dtype,
+                             kv_dtype=kv_dtype)
     cand = at.resolve("decode_attn_paged", key)
     return _dispatch_paged(cand, q, k_pages, v_pages, page_table, lengths,
-                           scale)
+                           scale, k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +452,15 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
 # ---------------------------------------------------------------------------
 
 _RUNNER_OPERANDS = {}
+
+
+def _is_q8(key):
+    return key.get("kv_dtype") == "int8"
+
+
+# synthetic runner/traceable operands quantize through the SAME grid the
+# serving cache writes with
+_q8_synth = quantize_kv
 
 
 def _operands(key):
@@ -296,15 +480,21 @@ def _operands(key):
             # representative fill: slots at staggered depths
             pos = (jnp.arange(b, dtype=jnp.int32) * (t // max(b, 1))
                    % jnp.asarray(max(t - s, 1), jnp.int32))
-        ops = _RUNNER_OPERANDS[ks] = (q, k, v, pos)
+            scales = None
+            if _is_q8(key):
+                (k, ksc), (v, vsc) = _q8_synth(k), _q8_synth(v)
+                scales = (ksc, vsc)
+        ops = _RUNNER_OPERANDS[ks] = (q, k, v, pos, scales)
     return ops
 
 
 def _runner(cand, key):
     from ..core.dtype import x64_scope
-    q, k, v, pos = _operands(key)
+    q, k, v, pos, scales = _operands(key)
+    kw = ({} if scales is None
+          else {"k_scales": scales[0], "v_scales": scales[1]})
     with x64_scope(False):
-        fn = jax.jit(functools.partial(_dispatch, cand, scale=None))
+        fn = jax.jit(functools.partial(_dispatch, cand, scale=None, **kw))
         fn(q, k, v, pos).block_until_ready()  # compile outside the timer
 
     def run():
@@ -320,10 +510,18 @@ def _traceable(cand, key):
     dt = jnp.dtype(key["dtype"])
     b, t, h, d, s = (key["slots"], key["t"], key["h"], key["d"],
                      key["qlen"])
+    kv_dt = jnp.int8 if _is_q8(key) else dt
     q = jax.ShapeDtypeStruct((b, s, h, d), dt)
-    k = jax.ShapeDtypeStruct((b, t, h, d), dt)
-    v = jax.ShapeDtypeStruct((b, t, h, d), dt)
+    k = jax.ShapeDtypeStruct((b, t, h, d), kv_dt)
+    v = jax.ShapeDtypeStruct((b, t, h, d), kv_dt)
     pos = jax.ShapeDtypeStruct((b,), jnp.int32)
+    if _is_q8(key):
+        sc = jax.ShapeDtypeStruct((b, t, h), jnp.float32)
+
+        def fn(q, k, v, pos, ks, vs):
+            return _dispatch(cand, q, k, v, pos, None,
+                             k_scales=ks, v_scales=vs)
+        return fn, (q, k, v, pos, sc, sc)
     return functools.partial(_dispatch, cand, scale=None), (q, k, v, pos)
 
 
@@ -349,15 +547,22 @@ def _paged_operands(key):
             t = mp * P
             pos = (jnp.arange(b, dtype=jnp.int32) * (t // max(b, 1))
                    % jnp.asarray(max(t - s, 1), jnp.int32))
-        ops = _RUNNER_OPERANDS[ks] = (q, kp, vp, table, pos)
+            scales = None
+            if _is_q8(key):
+                (kp, ksc), (vp, vsc) = _q8_synth(kp), _q8_synth(vp)
+                scales = (ksc, vsc)
+        ops = _RUNNER_OPERANDS[ks] = (q, kp, vp, table, pos, scales)
     return ops
 
 
 def _paged_runner(cand, key):
     from ..core.dtype import x64_scope
-    q, kp, vp, table, pos = _paged_operands(key)
+    q, kp, vp, table, pos, scales = _paged_operands(key)
+    kw = ({} if scales is None
+          else {"k_scales": scales[0], "v_scales": scales[1]})
     with x64_scope(False):
-        fn = jax.jit(functools.partial(_dispatch_paged, cand, scale=None))
+        fn = jax.jit(functools.partial(_dispatch_paged, cand, scale=None,
+                                       **kw))
         fn(q, kp, vp, table, pos).block_until_ready()  # compile untimed
 
     def run():
@@ -370,11 +575,19 @@ def _paged_traceable(cand, key):
     b, n_pages, P, mp, h, d, s = (
         key["slots"], key["pages"], key["page_size"], key["max_pages"],
         key["h"], key["d"], key["qlen"])
+    kv_dt = jnp.int8 if _is_q8(key) else dt
     q = jax.ShapeDtypeStruct((b, s, h, d), dt)
-    kp = jax.ShapeDtypeStruct((n_pages, P, h, d), dt)
-    vp = jax.ShapeDtypeStruct((n_pages, P, h, d), dt)
+    kp = jax.ShapeDtypeStruct((n_pages, P, h, d), kv_dt)
+    vp = jax.ShapeDtypeStruct((n_pages, P, h, d), kv_dt)
     table = jax.ShapeDtypeStruct((b, mp), jnp.int32)
     pos = jax.ShapeDtypeStruct((b,), jnp.int32)
+    if _is_q8(key):
+        sc = jax.ShapeDtypeStruct((n_pages, P, h), jnp.float32)
+
+        def fn(q, kp, vp, table, pos, ks, vs):
+            return _dispatch_paged(cand, q, kp, vp, table, pos, None,
+                                   k_scales=ks, v_scales=vs)
+        return fn, (q, kp, vp, table, pos, sc, sc)
     return (functools.partial(_dispatch_paged, cand, scale=None),
             (q, kp, vp, table, pos))
 

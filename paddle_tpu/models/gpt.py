@@ -350,9 +350,10 @@ class GPTScanBlocks(Layer):
             # inside ONE traced fn, over a clone of the view whose arrays
             # are that trace's own arguments (and outputs — no tracer
             # leaks onto the caller's view object).  The view declares
-            # which arrays it threads (carry_arrays: k/v/lengths for the
-            # slotted layout, + the page table for the paged one) and
-            # which come back mutated (k, v).
+            # which arrays it threads (carry_arrays: k/v, the int8 scale
+            # pools when quantized, the page table for the paged layout,
+            # lengths, and the opt-in quant-error scalar) and which come
+            # back mutated (k, v, scales, quant_err).
             seq = int(x.shape[1]) if hasattr(x, "shape") else 1
             carries = cache.carry_arrays()
 
@@ -592,26 +593,34 @@ class GPTForCausalLM(Layer):
             return logits, cache
         return logits
 
-    def gen_cache(self, batch_size, dtype="float32", max_len=None):
+    def gen_cache(self, batch_size, dtype="float32", max_len=None,
+                  kv_dtype=None):
         """Preallocated static-shape slotted KV cache
         (``serving.cache.SlottedKVCache``): one decode program shape for
         the life of the process.  ``batch_size`` is the number of slots;
-        ``max_len`` defaults to the model's position budget."""
+        ``max_len`` defaults to the model's position budget.
+        ``kv_dtype="int8"`` stores the pool quantized (int8 codes +
+        per-(row, head) f32 scales; appends quantize in-program and the
+        decode attention dequantizes inline — ``dtype`` then only names
+        the compute dtype the cache was built against)."""
         from ..serving.cache import SlottedKVCache
         c = self.config
         return SlottedKVCache.create(
             batch_size, c.num_hidden_layers,
             max_len or c.max_position_embeddings, c.num_attention_heads,
-            c.hidden_size // c.num_attention_heads, dtype)
+            c.hidden_size // c.num_attention_heads, dtype,
+            kv_dtype=kv_dtype)
 
     def gen_paged_cache(self, batch_size, dtype="float32", max_len=None,
-                        page_size=64):
+                        page_size=64, kv_dtype=None):
         """Preallocated paged KV cache (``serving.cache.PagedKVCache``)
         with a DENSE identity page table — slot ``i`` owns its own page
         run, so model-level use needs no allocator (the serving engine
         builds the pooled/shared layout through ``serving.pages``).
         ``model(x, cache=paged)`` decodes through the page-gather
-        attention path; capacity matches :meth:`gen_cache`."""
+        attention path; capacity matches :meth:`gen_cache`.
+        ``kv_dtype="int8"`` selects the quantized pool (see
+        :meth:`gen_cache`)."""
         from ..serving.cache import PagedKVCache
         c = self.config
         return PagedKVCache.create_dense(
@@ -619,7 +628,7 @@ class GPTForCausalLM(Layer):
             max_len or c.max_position_embeddings, c.num_attention_heads,
             c.hidden_size // c.num_attention_heads,
             min(int(page_size), int(max_len or c.max_position_embeddings)),
-            dtype)
+            dtype, kv_dtype=kv_dtype)
 
     def gen_legacy_concat_cache(self, batch_size, dtype="float32"):
         """COMPAT SHIM — the pre-serving concat-grown cache: the K/V

@@ -70,6 +70,20 @@ CATALOG = {
         "requeued for recompute (vLLM-style preemption; a request "
         "preempted past the scheduler's cap finishes 'cache_full' "
         "instead)"),
+    "serving.spec_proposed_tokens": _m(
+        "counter", "draft tokens proposed to the speculative verify "
+        "step (spec_k per active slot per iteration; pair with "
+        "serving.spec_accepted_tokens — accept rate = accepted / "
+        "proposed)"),
+    "serving.spec_accepted_tokens": _m(
+        "counter", "draft tokens the speculative verify step accepted "
+        "(the free extra tokens per iteration; the corrective/bonus "
+        "sample is not counted)"),
+    "serving.kv_quant_error": _m(
+        "gauge", "max abs dequantization error of the latest decode/"
+        "verify step's int8 KV appends (opt-in: "
+        "PADDLE_TPU_METRICS_KV_QUANT_ERROR=1 at engine construction; "
+        "forces one device sync per step)"),
 
     # -- training (TrainStep / hapi fit / amp / divergence sentinel) --------
     "train.step_seconds": _m(

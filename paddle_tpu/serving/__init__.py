@@ -1,10 +1,13 @@
 """paddle_tpu.serving — the TPU-native serving engine.
 
-Static-shape slotted KV cache (:mod:`.cache`), compile-once batched
-decode + bucketed prefill (:mod:`.engine`), Orca-style continuous
-batching (:mod:`.scheduler`), and per-slot greedy/temperature/top-k/
-top-p sampling with a threaded PRNG key (:mod:`.sampling`).
-See SERVING.md for the design and the on-chip A/B protocol.
+Static-shape paged/slotted KV caches with optional int8 quantization
+(:mod:`.cache`), compile-once batched decode + chunked/bucketed prefill
++ the speculative batched verify (:mod:`.engine`), self-speculative
+prompt-lookup drafting (:mod:`.spec`), Orca-style continuous batching
+(:mod:`.scheduler`), and per-slot greedy/temperature/top-k/top-p
+sampling plus the accept/resample rule with a threaded PRNG key
+(:mod:`.sampling`).  See SERVING.md for the design and the on-chip A/B
+protocol.
 
 Import discipline: ``models/gpt.py`` imports :mod:`.cache`, so this
 ``__init__`` must not eagerly import :mod:`.engine` (which imports the
@@ -14,16 +17,18 @@ from __future__ import annotations
 
 from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
                     PagedPrefillChunkView, PrefillView, SlottedKVCache,
-                    is_cache_view)
+                    dequantize_kv, is_cache_view, quantize_kv)
 from .pages import PageAllocator, PagePoolExhausted
-from .sampling import TOP_K_MAX, sample
+from .sampling import TOP_K_MAX, sample, spec_accept
+from .spec import propose
 
 __all__ = [
     "SlottedKVCache", "DecodeView", "PrefillView", "PagedKVCache",
     "PagedDecodeView", "PagedPrefillChunkView", "PageAllocator",
-    "PagePoolExhausted", "is_cache_view",
-    "sample", "TOP_K_MAX", "DecodeEngine", "ContinuousBatchingScheduler",
-    "Request", "RequestResult", "PrefillTask", "generate", "engine_for",
+    "PagePoolExhausted", "is_cache_view", "quantize_kv", "dequantize_kv",
+    "sample", "spec_accept", "propose", "TOP_K_MAX", "DecodeEngine",
+    "ContinuousBatchingScheduler", "Request", "RequestResult",
+    "PrefillTask", "generate", "engine_for",
 ]
 
 _LAZY = {

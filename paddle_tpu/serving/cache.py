@@ -30,11 +30,28 @@ the whole model**.  Two static layouts live here:
   of it stays compile-once: the page table, lengths, and gather indices
   are ordinary traced int32 arrays.
 
+**int8 quantized KV (ISSUE 8).**  Either layout can store the pool as
+int8 codes plus per-(row, head) f32 scales (``kv_dtype="int8"`` at
+:meth:`create`): appends *quantize in-program* (symmetric amax/127 grid
+— :func:`quantize_kv`) and the decode-attention q8 variants dequantize
+inline in the gather, so decode HBM traffic per K/V row drops from
+``head_dim * 2`` bytes (bf16) to ``head_dim + 4`` (int8 codes + one f32
+scale per head).  The scale pools mirror the code pools' page/slot
+structure:
+
+      k_scale, v_scale : (num_pages, layers, page_size, heads)  f32   (paged)
+      k_scale, v_scale : (num_slots, layers, max_len, heads)    f32   (slotted)
+
+The plumbing is fp8-ready: only the grid constant and the code dtype
+change for e4m3 — scale layout, scatter paths and the dequant-in-gather
+kernels are shared.
+
 Attention over either layout is masked to each slot's valid prefix: the
 query token at block offset ``j`` of a slot with pre-append length ``n``
 sits at global position ``n + j`` and may attend keys ``t <= n + j``.
-That one formula covers batched decode (``j = 0``), multi-token
-appends, chunked prefill (``j`` ranges over the chunk), and whole-prompt
+That one formula covers batched decode (``j = 0``), multi-token appends
+(speculative verify scores ``k + 1`` positions through exactly this
+path), chunked prefill (``j`` ranges over the chunk), and whole-prompt
 prefill (``n = 0`` reduces it to the causal mask).
 
 *Views* adapt a cache to the model's per-layer walk (they are
@@ -52,6 +69,15 @@ trace-time carriers, not pytrees — the arrays they hold thread through
   attends to the full mapped past + itself (the chunked-prefill
   program the engine interleaves with decode).
 
+A view's *carry fields* — the traced arrays it threads through a
+re-entrant walk — are dynamic: ``k, v`` always, ``k_scale, v_scale``
+when the cache is quantized, the page table for paged views,
+``lengths``, and (opt-in) a ``quant_err`` f32 scalar accumulating the
+max abs dequantization error of the step's appends (the
+``serving.kv_quant_error`` gauge).  :meth:`_CacheView.carry_fields`
+is the single source of that ordering; ``clone_raw``/``adopt`` and the
+scan-layers re-entry in ``models/gpt.py`` follow it.
+
 Dependency note: this module is imported by ``models/gpt.py`` and must
 stay model-free (jax + the decode-attention kernel family only).
 """
@@ -60,22 +86,58 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# the int8 grid lives with the q8 kernels (ONE canonical definition —
+# the autotune runners synthesize operands through the same math, so the
+# cache's writes and the kernels' reads can never drift); re-exported
+# here as serving API
+from ..kernels.decode_attention import dequantize_kv, quantize_kv
+
 __all__ = ["SlottedKVCache", "DecodeView", "PrefillView", "PagedKVCache",
-           "PagedDecodeView", "PagedPrefillChunkView", "is_cache_view"]
+           "PagedDecodeView", "PagedPrefillChunkView", "is_cache_view",
+           "quantize_kv", "dequantize_kv"]
+
+
+def _as_kv_dtypes(kv_dtype):
+    """(code dtype, scale dtype or None) for a cache ``kv_dtype``."""
+    if kv_dtype is None:
+        return None, None
+    dt = jnp.dtype(kv_dtype)
+    if dt != jnp.int8:
+        raise ValueError("kv_dtype %r unsupported (int8 only; the scale "
+                         "plumbing is fp8-ready but e4m3 needs a jax with "
+                         "float8 pallas support)" % (kv_dtype,))
+    return dt, jnp.float32
+
+
+def _append_quant_err(prev, pairs):
+    """Fold the max abs dequant error of freshly quantized appends into
+    the running ``quant_err`` scalar (``prev`` None = tracking off)."""
+    if prev is None:
+        return None
+    err = prev
+    for x, q, s in pairs:
+        d = dequantize_kv(q, s, jnp.float32) - x.astype(jnp.float32)
+        err = jnp.maximum(err, jnp.max(jnp.abs(d)))
+    return err
 
 
 @jax.tree_util.register_pytree_node_class
 class SlottedKVCache:
     """The preallocated cache state.  A registered pytree, so it passes
-    through ``jax.jit`` boundaries (and ``donate_argnums``) directly."""
+    through ``jax.jit`` boundaries (and ``donate_argnums``) directly.
+    ``k_scale``/``v_scale`` are the per-(row, head) f32 scale pools of
+    the int8 layout (None for the unquantized one)."""
 
-    def __init__(self, k, v, lengths):
+    def __init__(self, k, v, lengths, k_scale=None, v_scale=None):
         self.k = k
         self.v = v
         self.lengths = lengths
+        self.k_scale = k_scale
+        self.v_scale = v_scale
 
     def tree_flatten(self):
-        return (self.k, self.v, self.lengths), None
+        return (self.k, self.v, self.lengths, self.k_scale,
+                self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -83,11 +145,22 @@ class SlottedKVCache:
 
     @classmethod
     def create(cls, num_slots, num_layers, max_len, num_heads, head_dim,
-               dtype="float32"):
+               dtype="float32", kv_dtype=None):
+        code_dt, scale_dt = _as_kv_dtypes(kv_dtype)
+        pool_dt = dtype if code_dt is None else code_dt
         shape = (int(num_slots), int(num_layers), int(max_len),
                  int(num_heads), int(head_dim))
-        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
-                   jnp.zeros((int(num_slots),), jnp.int32))
+        ks = vs = None
+        if scale_dt is not None:
+            ks = jnp.zeros(shape[:-1], scale_dt)
+            vs = jnp.zeros(shape[:-1], scale_dt)
+        return cls(jnp.zeros(shape, pool_dt), jnp.zeros(shape, pool_dt),
+                   jnp.zeros((int(num_slots),), jnp.int32),
+                   k_scale=ks, v_scale=vs)
+
+    @property
+    def quantized(self):
+        return self.k_scale is not None
 
     # -- static geometry (python ints — safe at trace time) ----------------
     @property
@@ -114,13 +187,18 @@ class PagedKVCache:
     plus a per-slot page table.  A registered pytree, so it passes through
     ``jax.jit`` boundaries (and ``donate_argnums``) directly.  Unmapped
     page-table entries hold 0 — they gather page 0's bytes, which the
-    length mask discards before they reach the softmax."""
+    length mask discards before they reach the softmax.  ``k_scale``/
+    ``v_scale`` are the per-(page row, head) f32 scale pools of the int8
+    layout (None for the unquantized one)."""
 
-    def __init__(self, k, v, page_table, lengths, declared_max_len=None):
+    def __init__(self, k, v, page_table, lengths, declared_max_len=None,
+                 k_scale=None, v_scale=None):
         self.k = k
         self.v = v
         self.page_table = page_table
         self.lengths = lengths
+        self.k_scale = k_scale
+        self.v_scale = v_scale
         # the DECLARED length budget, when tighter than pool capacity
         # (max_len % page_size != 0 leaves dead rows in the tail page);
         # static aux data, so it survives jit boundaries and tree maps
@@ -128,25 +206,34 @@ class PagedKVCache:
                                  else int(declared_max_len))
 
     def tree_flatten(self):
-        return ((self.k, self.v, self.page_table, self.lengths),
-                self.declared_max_len)
+        return ((self.k, self.v, self.page_table, self.lengths,
+                 self.k_scale, self.v_scale), self.declared_max_len)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, declared_max_len=aux)
+        k, v, table, lengths, ks, vs = children
+        return cls(k, v, table, lengths, declared_max_len=aux,
+                   k_scale=ks, v_scale=vs)
 
     @classmethod
     def create(cls, num_pages, num_layers, page_size, num_heads, head_dim,
-               num_slots, max_pages, dtype="float32"):
+               num_slots, max_pages, dtype="float32", kv_dtype=None):
+        code_dt, scale_dt = _as_kv_dtypes(kv_dtype)
+        pool_dt = dtype if code_dt is None else code_dt
         shape = (int(num_pages), int(num_layers), int(page_size),
                  int(num_heads), int(head_dim))
-        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+        ks = vs = None
+        if scale_dt is not None:
+            ks = jnp.zeros(shape[:-1], scale_dt)
+            vs = jnp.zeros(shape[:-1], scale_dt)
+        return cls(jnp.zeros(shape, pool_dt), jnp.zeros(shape, pool_dt),
                    jnp.zeros((int(num_slots), int(max_pages)), jnp.int32),
-                   jnp.zeros((int(num_slots),), jnp.int32))
+                   jnp.zeros((int(num_slots),), jnp.int32),
+                   k_scale=ks, v_scale=vs)
 
     @classmethod
     def create_dense(cls, num_slots, num_layers, max_len, num_heads,
-                     head_dim, page_size, dtype="float32"):
+                     head_dim, page_size, dtype="float32", kv_dtype=None):
         """A pool with exactly one page set per slot, identity-mapped
         (slot ``i`` owns pages ``[i*max_pages, (i+1)*max_pages)``) — the
         allocator-free layout for model-level use (``gen_paged_cache``):
@@ -154,12 +241,17 @@ class PagedKVCache:
         max_pages = -(-int(max_len) // int(page_size))
         cache = cls.create(int(num_slots) * max_pages, num_layers,
                            page_size, num_heads, head_dim, num_slots,
-                           max_pages, dtype)
+                           max_pages, dtype, kv_dtype=kv_dtype)
         table = jnp.arange(int(num_slots) * max_pages,
                            dtype=jnp.int32).reshape(int(num_slots),
                                                     max_pages)
         return cls(cache.k, cache.v, table, cache.lengths,
-                   declared_max_len=int(max_len))
+                   declared_max_len=int(max_len),
+                   k_scale=cache.k_scale, v_scale=cache.v_scale)
+
+    @property
+    def quantized(self):
+        return self.k_scale is not None
 
     # -- static geometry (python ints — safe at trace time) ----------------
     @property
@@ -203,7 +295,8 @@ def _unwrap(x):
     return x._array if hasattr(x, "_array") else x
 
 
-def paged_scatter(kc, vc, layer, table, pos, valid, k_new, v_new):
+def paged_scatter(kc, vc, layer, table, pos, valid, k_new, v_new,
+                  ksc=None, vsc=None, ks_new=None, vs_new=None):
     """Scatter ``k_new/v_new: (B, s, heads, head_dim)`` into page rows.
 
     ``table: (B, max_pages)`` maps each lane's pages; ``pos: (B, s)`` are
@@ -212,7 +305,11 @@ def paged_scatter(kc, vc, layer, table, pos, valid, k_new, v_new):
     page id ``num_pages``, an out-of-bounds index XLA's default scatter
     mode DROPS (the same trick the slotted cache uses for rows past
     ``max_len``).  Distinct valid lanes never collide: the allocator
-    copy-on-writes any shared page before a write can target it."""
+    copy-on-writes any shared page before a write can target it.  For the
+    int8 layout, ``ks_new/vs_new: (B, s, heads)`` scale rows scatter into
+    the ``ksc/vsc`` scale pools through the SAME routed indices.
+    Returns ``(kc, vc, ksc, vsc)`` (scale pools pass through as None
+    when unquantized)."""
     P = int(kc.shape[2])
     max_pages = int(table.shape[1])
     num_pages = int(kc.shape[0])
@@ -226,7 +323,10 @@ def paged_scatter(kc, vc, layer, table, pos, valid, k_new, v_new):
     l_idx = jnp.asarray(layer, jnp.int32)
     kc = kc.at[page_id, l_idx, row].set(k_new.astype(kc.dtype))
     vc = vc.at[page_id, l_idx, row].set(v_new.astype(vc.dtype))
-    return kc, vc
+    if ksc is not None:
+        ksc = ksc.at[page_id, l_idx, row].set(ks_new.astype(ksc.dtype))
+        vsc = vsc.at[page_id, l_idx, row].set(vs_new.astype(vsc.dtype))
+    return kc, vc, ksc, vsc
 
 
 class _CacheView:
@@ -235,19 +335,53 @@ class _CacheView:
     or :meth:`attend_raw` (raw arrays, for the scan-layers block body) in
     order; the view allocates layer indices from an internal cursor.
 
-    ``_carry_fields`` names the traced arrays the view threads through a
-    re-entrant walk (the scan-layers path passes them across its own
-    ``call`` boundary via :meth:`carry_arrays`/:meth:`clone_raw`); the
-    first two — k, v — are the only ones a layer MUTATES
-    (:meth:`mutated_arrays`)."""
+    :meth:`carry_fields` names the traced arrays the view threads through
+    a re-entrant walk (the scan-layers path passes them across its own
+    ``call`` boundary via :meth:`carry_arrays`/:meth:`clone_raw`);
+    :meth:`mutated_fields` is the subset a layer MUTATES — ``k, v``, plus
+    the scale pools when the cache is quantized, plus the ``quant_err``
+    accumulator when tracking is on."""
 
-    _carry_fields = ("k", "v", "lengths")
+    #: layout-specific carry fields between the scale pools and lengths
+    #: (the paged views add "page_table")
+    _extra_fields = ()
 
-    def __init__(self, cache):
+    def __init__(self, cache, track_quant_err=False):
         self.k = _unwrap(cache.k)
         self.v = _unwrap(cache.v)
+        ks = getattr(cache, "k_scale", None)
+        vs = getattr(cache, "v_scale", None)
+        self.k_scale = None if ks is None else _unwrap(ks)
+        self.v_scale = None if vs is None else _unwrap(vs)
         self.lengths = _unwrap(cache.lengths)
+        # opt-in per-step quantization-error accumulator (a traced f32
+        # scalar carried through the walk; the serving.kv_quant_error
+        # gauge reads it from the entry's outputs)
+        self.quant_err = (jnp.zeros((), jnp.float32)
+                          if (track_quant_err and self.quantized) else None)
         self._layer = 0
+
+    @property
+    def quantized(self):
+        return self.k_scale is not None
+
+    def carry_fields(self):
+        f = ["k", "v"]
+        if self.quantized:
+            f += ["k_scale", "v_scale"]
+        f += list(self._extra_fields)
+        f.append("lengths")
+        if self.quant_err is not None:
+            f.append("quant_err")
+        return tuple(f)
+
+    def mutated_fields(self):
+        f = ["k", "v"]
+        if self.quantized:
+            f += ["k_scale", "v_scale"]
+        if self.quant_err is not None:
+            f.append("quant_err")
+        return tuple(f)
 
     def _alloc_layer(self) -> int:
         i = self._layer
@@ -260,13 +394,13 @@ class _CacheView:
 
     def carry_arrays(self):
         """The traced arrays a re-entrant walk must pass across its own
-        trace boundary, in :meth:`clone_raw` order."""
-        return tuple(getattr(self, f) for f in self._carry_fields)
+        trace boundary, in :meth:`carry_fields` order."""
+        return tuple(getattr(self, f) for f in self.carry_fields())
 
     def mutated_arrays(self):
-        """The subset of :meth:`carry_arrays` the walk mutates (k, v) —
-        what the re-entrant fn returns and :meth:`adopt` takes back."""
-        return (self.k, self.v)
+        """The subset of :meth:`carry_arrays` the walk mutates — what the
+        re-entrant fn returns and :meth:`adopt` takes back."""
+        return tuple(getattr(self, f) for f in self.mutated_fields())
 
     def attend(self, q, k_new, v_new, scale=None):
         """Tensor-level append+attend (dispatches through core.dispatch.call
@@ -277,45 +411,64 @@ class _CacheView:
         n = len(carry)
 
         def raw(*args):
-            out, kc2, vc2 = self._append_attend_raw(
+            return self._append_attend_raw(
                 layer, args[:n], args[n], args[n + 1], args[n + 2], scale)
-            return out, kc2, vc2
 
-        out, kc, vc = call(raw, *carry, q, k_new, v_new,
-                           name="slotted_kv_attend")
-        self.k, self.v = _unwrap(kc), _unwrap(vc)
-        return out
+        res = call(raw, *carry, q, k_new, v_new,
+                   name="slotted_kv_attend")
+        for f, a in zip(self.mutated_fields(), res[1:]):
+            setattr(self, f, _unwrap(a))
+        return res[0]
 
     def attend_raw(self, q, k_new, v_new, scale=None):
         """Raw-array append+attend (the scan-layers block body path)."""
         layer = self._alloc_layer()
-        out, self.k, self.v = self._append_attend_raw(
+        res = self._append_attend_raw(
             layer, self.carry_arrays(), q, k_new, v_new, scale)
-        return out
+        for f, a in zip(self.mutated_fields(), res[1:]):
+            setattr(self, f, a)
+        return res[0]
 
     def clone_raw(self, *arrays):
         """A fresh same-typed view over explicit raw arrays (in
-        ``_carry_fields`` order) — for code that re-enters the per-layer
-        walk inside its own traced function (the scan-layers decode
-        path): the clone's arrays are that trace's arguments, so no
-        tracer ever leaks onto this view."""
+        :meth:`carry_fields` order) — for code that re-enters the
+        per-layer walk inside its own traced function (the scan-layers
+        decode path): the clone's arrays are that trace's arguments, so
+        no tracer ever leaks onto this view."""
         import copy
-        if len(arrays) != len(self._carry_fields):
+        fields = self.carry_fields()
+        if len(arrays) != len(fields):
             raise ValueError("clone_raw expects %d arrays %r, got %d"
-                             % (len(self._carry_fields),
-                                self._carry_fields, len(arrays)))
+                             % (len(fields), fields, len(arrays)))
         c = copy.copy(self)
-        for f, a in zip(self._carry_fields, arrays):
+        for f, a in zip(fields, arrays):
             setattr(c, f, _unwrap(a))
         c._layer = 0
         return c
 
-    def adopt(self, k, v, steps=None):
-        """Take the (concrete) arrays a traced clone produced as outputs."""
-        self.k, self.v = _unwrap(k), _unwrap(v)
+    def adopt(self, *arrays, steps=None):
+        """Take the (concrete) arrays a traced clone produced as outputs,
+        in :meth:`mutated_fields` order."""
+        fields = self.mutated_fields()
+        if len(arrays) != len(fields):
+            raise ValueError("adopt expects %d arrays %r, got %d"
+                             % (len(fields), fields, len(arrays)))
+        for f, a in zip(fields, arrays):
+            setattr(self, f, _unwrap(a))
         self._layer = int(self.k.shape[1])
         if steps is not None and hasattr(self, "_steps"):
             self._steps = int(steps)
+
+    # -- shared quantized-append helper ------------------------------------
+
+    def _quantize_new(self, c, k_new, v_new):
+        """Quantize fresh K/V rows and fold their dequant error into the
+        carried accumulator; returns (kq, ks, vq, vs, new_err)."""
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        err = _append_quant_err(c.get("quant_err"),
+                                ((k_new, kq, ks), (v_new, vq, vs)))
+        return kq, ks, vq, vs, err
 
 
 class DecodeView(_CacheView):
@@ -327,8 +480,9 @@ class DecodeView(_CacheView):
     the program shape never changes — but their writes land past their
     frozen valid prefix and are overwritten on slot reuse)."""
 
-    def __init__(self, cache: SlottedKVCache, active=None):
-        super().__init__(cache)
+    def __init__(self, cache: SlottedKVCache, active=None,
+                 track_quant_err=False):
+        super().__init__(cache, track_quant_err=track_quant_err)
         self.active = None if active is None else _unwrap(active)
         self._steps = 0
 
@@ -343,7 +497,8 @@ class DecodeView(_CacheView):
 
     def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels.decode_attention import decode_attention
-        kc, vc, lengths = carry
+        c = dict(zip(self.carry_fields(), carry))
+        kc, vc, lengths = c["k"], c["v"], c["lengths"]
         s = int(q.shape[1])
         self._steps = s
         b_idx = jnp.arange(kc.shape[0], dtype=jnp.int32)[:, None]
@@ -351,17 +506,33 @@ class DecodeView(_CacheView):
         # one scatter into the (donated) full cache buffer per array; XLA
         # updates in place (the operand chains through each layer's write).
         # Rows past max_len (a slot the scheduler failed to evict) drop.
+        if self.quantized:
+            kq, ks, vq, vs, err = self._quantize_new(c, k_new, v_new)
+            kc = kc.at[b_idx, layer, t_idx].set(kq)
+            vc = vc.at[b_idx, layer, t_idx].set(vq)
+            ksc = c["k_scale"].at[b_idx, layer, t_idx].set(ks)
+            vsc = c["v_scale"].at[b_idx, layer, t_idx].set(vs)
+            out = decode_attention(q, kc[:, layer], vc[:, layer], lengths,
+                                   scale=scale, k_scales=ksc[:, layer],
+                                   v_scales=vsc[:, layer])
+            mut = (kc, vc, ksc, vsc) + (() if err is None else (err,))
+            return (out,) + mut
         kc = kc.at[b_idx, layer, t_idx].set(k_new.astype(kc.dtype))
         vc = vc.at[b_idx, layer, t_idx].set(v_new.astype(vc.dtype))
         out = decode_attention(q, kc[:, layer], vc[:, layer], lengths,
                                scale=scale)
         return out, kc, vc
 
-    def finalize(self) -> SlottedKVCache:
-        adv = jnp.asarray(self._steps, jnp.int32)
+    def finalize(self, advance=None) -> SlottedKVCache:
+        """``advance`` (per-slot int32, optional) overrides the uniform
+        per-step advance — the speculative verify entry passes the
+        ACCEPTED count + 1 so rejected drafts roll back in-program."""
+        adv = (jnp.asarray(self._steps, jnp.int32) if advance is None
+               else jnp.asarray(advance, jnp.int32))
         if self.active is not None:
             adv = adv * self.active.astype(jnp.int32)
-        return SlottedKVCache(self.k, self.v, self.lengths + adv)
+        return SlottedKVCache(self.k, self.v, self.lengths + adv,
+                              k_scale=self.k_scale, v_scale=self.v_scale)
 
 
 class PrefillView(_CacheView):
@@ -370,7 +541,9 @@ class PrefillView(_CacheView):
     Writes rows ``[0, bucket)`` of the (dynamic) ``slot`` via
     ``dynamic_update_slice`` and attends block-causally — pad rows
     compute garbage that is masked forever (``lengths[slot] = true_len``)
-    and progressively overwritten by subsequent decode appends."""
+    and progressively overwritten by subsequent decode appends.  Int8
+    caches quantize the written rows; the block attention itself runs on
+    the exact pre-quantization K/V (nothing prior to attend to)."""
 
     def __init__(self, cache: SlottedKVCache, slot, true_len):
         super().__init__(cache)
@@ -386,13 +559,23 @@ class PrefillView(_CacheView):
     def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels import flash_attention as fa
         from ..nn.functional.attention import sdpa_reference_raw
-        kc, vc, lengths = carry
+        c = dict(zip(self.carry_fields(), carry))
+        kc, vc = c["k"], c["v"]
         zero = jnp.zeros((), jnp.int32)
         start = (self.slot, jnp.asarray(layer, jnp.int32), zero, zero, zero)
-        kc = jax.lax.dynamic_update_slice(
-            kc, k_new.astype(kc.dtype)[:, None], start)
-        vc = jax.lax.dynamic_update_slice(
-            vc, v_new.astype(vc.dtype)[:, None], start)
+        if self.quantized:
+            kq, ks, vq, vs, _err = self._quantize_new(c, k_new, v_new)
+            kc = jax.lax.dynamic_update_slice(kc, kq[:, None], start)
+            vc = jax.lax.dynamic_update_slice(vc, vq[:, None], start)
+            ksc = jax.lax.dynamic_update_slice(
+                c["k_scale"], ks[:, None], start[:-1])
+            vsc = jax.lax.dynamic_update_slice(
+                c["v_scale"], vs[:, None], start[:-1])
+        else:
+            kc = jax.lax.dynamic_update_slice(
+                kc, k_new.astype(kc.dtype)[:, None], start)
+            vc = jax.lax.dynamic_update_slice(
+                vc, v_new.astype(vc.dtype)[:, None], start)
         # fresh slot: nothing precedes the block — attention is plain
         # causal over the bucket (bucket^2 logits, not bucket*max_len),
         # through the Pallas flash kernel when the shapes support it
@@ -401,11 +584,14 @@ class PrefillView(_CacheView):
                                           scale=scale)
         else:
             out = sdpa_reference_raw(q, k_new, v_new, None, 0.0, True, scale)
+        if self.quantized:
+            return out, kc, vc, ksc, vsc
         return out, kc, vc
 
     def finalize(self) -> SlottedKVCache:
         return SlottedKVCache(
-            self.k, self.v, self.lengths.at[self.slot].set(self.true_len))
+            self.k, self.v, self.lengths.at[self.slot].set(self.true_len),
+            k_scale=self.k_scale, v_scale=self.v_scale)
 
 
 class PagedDecodeView(_CacheView):
@@ -417,10 +603,11 @@ class PagedDecodeView(_CacheView):
     out-of-bounds page id): a retired slot's stale table row may point at
     pages the allocator has reassigned, so its lane must never write."""
 
-    _carry_fields = ("k", "v", "page_table", "lengths")
+    _extra_fields = ("page_table",)
 
-    def __init__(self, cache: PagedKVCache, active=None, max_len=None):
-        super().__init__(cache)
+    def __init__(self, cache: PagedKVCache, active=None, max_len=None,
+                 track_quant_err=False):
+        super().__init__(cache, track_quant_err=track_quant_err)
         self.page_table = _unwrap(cache.page_table)
         self.active = None if active is None else _unwrap(active)
         # write/length cap: the engine's DECLARED max_len can be tighter
@@ -442,28 +629,47 @@ class PagedDecodeView(_CacheView):
 
     def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels.decode_attention import paged_decode_attention
-        kc, vc, table, lengths = carry
+        c = dict(zip(self.carry_fields(), carry))
+        kc, vc, table, lengths = c["k"], c["v"], c["page_table"], \
+            c["lengths"]
         s = int(q.shape[1])
         self._steps = s
         pos = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
         valid = pos < jnp.asarray(self.max_len, jnp.int32)
         if self.active is not None:
             valid = valid & self.active[:, None]
-        kc, vc = paged_scatter(kc, vc, layer, table, pos, valid,
-                               k_new, v_new)
+        if self.quantized:
+            kq, ks, vq, vs, err = self._quantize_new(c, k_new, v_new)
+            kc, vc, ksc, vsc = paged_scatter(
+                kc, vc, layer, table, pos, valid, kq, vq,
+                ksc=c["k_scale"], vsc=c["v_scale"], ks_new=ks, vs_new=vs)
+            out = paged_decode_attention(
+                q, kc[:, layer], vc[:, layer], table, lengths, scale=scale,
+                k_scales=ksc[:, layer], v_scales=vsc[:, layer])
+            mut = (kc, vc, ksc, vsc) + (() if err is None else (err,))
+            return (out,) + mut
+        kc, vc, _, _ = paged_scatter(kc, vc, layer, table, pos, valid,
+                                     k_new, v_new)
         out = paged_decode_attention(q, kc[:, layer], vc[:, layer], table,
                                      lengths, scale=scale)
         return out, kc, vc
 
-    def finalize(self) -> PagedKVCache:
-        adv = jnp.asarray(self._steps, jnp.int32)
+    def finalize(self, advance=None) -> PagedKVCache:
+        """``advance`` (per-slot int32, optional) overrides the uniform
+        per-step advance — the speculative verify entry passes the
+        ACCEPTED count + 1, rolling rejected drafts' length advance (and
+        so their tail-page rows, overwritten by the next append) back
+        in-program."""
+        adv = (jnp.asarray(self._steps, jnp.int32) if advance is None
+               else jnp.asarray(advance, jnp.int32))
         if self.active is not None:
             adv = adv * self.active.astype(jnp.int32)
         return PagedKVCache(self.k, self.v, self.page_table,
                             jnp.minimum(self.lengths + adv,
                                         jnp.asarray(self.max_len,
                                                     jnp.int32)),
-                            declared_max_len=self.max_len)
+                            declared_max_len=self.max_len,
+                            k_scale=self.k_scale, v_scale=self.v_scale)
 
 
 class PagedPrefillChunkView(_CacheView):
@@ -475,9 +681,11 @@ class PagedPrefillChunkView(_CacheView):
     page-table row and masks ``t <= n_before + j`` — the full mapped
     past (shared prefix pages included) plus the chunk's own causal
     band, so a chunk after a prefix-cache hit attends to pages it never
-    computed."""
+    computed.  Int8 caches quantize the chunk's writes; its attention
+    reads back through the dequantizing gather (the chunk attends its
+    own quantized rows — the same values every later decode step sees)."""
 
-    _carry_fields = ("k", "v", "page_table", "lengths")
+    _extra_fields = ("page_table",)
 
     def __init__(self, cache: PagedKVCache, slot, n_before, n_valid):
         super().__init__(cache)
@@ -497,7 +705,8 @@ class PagedPrefillChunkView(_CacheView):
 
     def _append_attend_raw(self, layer, carry, q, k_new, v_new, scale):
         from ..kernels.decode_attention import paged_decode_attention
-        kc, vc, table, lengths = carry
+        c = dict(zip(self.carry_fields(), carry))
+        kc, vc, table = c["k"], c["v"], c["page_table"]
         C = int(q.shape[1])
         max_pages = int(table.shape[1])
         row_tab = jax.lax.dynamic_slice(
@@ -505,8 +714,17 @@ class PagedPrefillChunkView(_CacheView):
         j = jnp.arange(C, dtype=jnp.int32)
         pos = (self.n_before + j)[None, :]
         valid = (j < self.n_valid)[None, :]
-        kc, vc = paged_scatter(kc, vc, layer, row_tab, pos, valid,
-                               k_new, v_new)
+        if self.quantized:
+            kq, ks, vq, vs, _err = self._quantize_new(c, k_new, v_new)
+            kc, vc, ksc, vsc = paged_scatter(
+                kc, vc, layer, row_tab, pos, valid, kq, vq,
+                ksc=c["k_scale"], vsc=c["v_scale"], ks_new=ks, vs_new=vs)
+            out = paged_decode_attention(
+                q, kc[:, layer], vc[:, layer], row_tab, self.n_before[None],
+                scale=scale, k_scales=ksc[:, layer], v_scales=vsc[:, layer])
+            return out, kc, vc, ksc, vsc
+        kc, vc, _, _ = paged_scatter(kc, vc, layer, row_tab, pos, valid,
+                                     k_new, v_new)
         out = paged_decode_attention(q, kc[:, layer], vc[:, layer],
                                      row_tab, self.n_before[None],
                                      scale=scale)
@@ -516,4 +734,5 @@ class PagedPrefillChunkView(_CacheView):
         return PagedKVCache(
             self.k, self.v, self.page_table,
             self.lengths.at[self.slot].set(self.n_before + self.n_valid),
-            declared_max_len=self.declared_max_len)
+            declared_max_len=self.declared_max_len,
+            k_scale=self.k_scale, v_scale=self.v_scale)

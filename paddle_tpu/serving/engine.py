@@ -5,21 +5,35 @@ Two cache layouts (``paged=True`` is the default — ISSUE 7):
 
 * **Paged** — a fixed pool of fixed-size KV pages plus a per-slot int32
   page table (:class:`~.cache.PagedKVCache` + the host-side
-  :class:`~.pages.PageAllocator`).  Three compiled entry points:
+  :class:`~.pages.PageAllocator`).  Compiled entry points:
 
   - ``decode`` — ALL slots advance one token in one fixed-shape
     program: scatter-append into each slot's tail page, paged-gather
     length-masked attention (``kernels.decode_attention`` family
     ``decode_attn_paged``), per-slot sampling.  Compiles ONCE.
+  - ``spec_verify`` (``spec_k > 0`` — ISSUE 8) — the speculative
+    **batched verify**: each slot's iteration input is ``k + 1`` tokens
+    (the last committed token plus ``k`` host-side prompt-lookup
+    drafts, :mod:`.spec`), ONE forward scores all positions over the
+    paged cache, and the standard accept/resample rule
+    (:func:`~.sampling.spec_accept`) runs in-program: rejected drafts
+    roll the per-slot length counters (and with them the tail-page
+    rows, overwritten by the next append) back INSIDE the program — no
+    host sync on the hot path.  Fixed ``k`` means this is ONE static
+    program (watchdog budget 1) beside the single-token ``decode``
+    fallback; accept-rate extremes change traced values, never the
+    program.  Greedy output is bit-identical to non-speculative decode;
+    temperature sampling consumes exactly ONE threaded key per
+    iteration regardless of accepted count (PR 7's seed-reproducibility
+    contract).
   - ``prefill_chunk`` — one fixed-size chunk of one slot's prompt:
     admitting a long prompt runs ``ceil(n / chunk)`` iterations of this
     ONE program, interleaved by the scheduler with live decode steps so
-    a long admission can no longer stall in-flight TPOT.  (This
-    replaces the slotted path's ``log2(max_len)`` bucketed prefill
-    programs with a single compile.)  The final chunk samples the first
-    generated token from the prompt's last position.
-  - ``cow_copy`` — copy one page (all layers) to a fresh page: the
-    copy-on-write step that un-shares a prefix page before a write.
+    a long admission can no longer stall in-flight TPOT.  The final
+    chunk samples the first generated token.
+  - ``cow_copy`` — copy one page (all layers, scale rows included) to a
+    fresh page: the copy-on-write step that un-shares a prefix page
+    before a write.
 
   **Prefix sharing**: prompt pages are content-hashed at admission; a
   hit maps the slot's leading page-table entries to existing refcounted
@@ -33,12 +47,26 @@ Two cache layouts (``paged=True`` is the default — ISSUE 7):
   parity): per-slot contiguous ``max_len`` buffers, bucketed whole-
   prompt prefill.
 
-Every argument that varies across steps (tokens, active mask, sampling
-parameters, PRNG key, page table, lengths) is a traced array — nothing
-retraces, ever; asserted by ``decode_compile_count`` and the recompile
-watchdog.  All entries **donate the cache buffers**: XLA aliases them
-input→output, so the multi-hundred-MB pool is updated in place instead
-of double-buffered (TPU502 audits that the aliasing actually
+**int8 KV cache (``kv_dtype="int8"`` — ISSUE 8).**  Either layout can
+store the pool as int8 codes + per-(row, head) f32 scales
+(:mod:`.cache`): appends quantize in-program, the attention families'
+q8 variants dequantize inline in the gather, and decode KV HBM traffic
+per row drops from ``head_dim * dtype_bytes`` to ``head_dim + 4`` —
+about HALF the bf16 pool's read bound at head_dim 64
+(``kv_bytes_per_token()`` accounts codes + scales honestly).  Composes
+with speculative decode: the verify program runs the same q8 gather.
+Opt-in ``PADDLE_TPU_METRICS_KV_QUANT_ERROR=1`` (at engine construction)
+threads a max-abs-dequant-error accumulator through the decode/verify
+entries and publishes the ``serving.kv_quant_error`` gauge (one device
+sync per step, same caveat as ``train.grad_norm``).
+
+Every argument that varies across steps (tokens, draft tokens, active
+mask, sampling parameters, PRNG key, page table, lengths) is a traced
+array — nothing retraces, ever; asserted by ``decode_compile_count``/
+``verify_compile_count`` and the recompile watchdog.  All entries
+**donate the cache buffers** (code pools AND scale pools): XLA aliases
+them input→output, so the multi-hundred-MB pool is updated in place
+instead of double-buffered (TPU502 audits that the aliasing actually
 materializes — see ``analysis/trace/programs.py``'s ``serving``
 builder).  The page table is a per-step *input* (host-owned, re-uploaded
 only when it changes), not donated.
@@ -52,6 +80,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 
 import numpy as np
 
@@ -62,9 +91,10 @@ from ..core.dtype import x64_scope
 from ..core.tensor import Tensor
 from ..observability import registry as _metrics
 from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
-                    PagedPrefillChunkView, PrefillView, SlottedKVCache)
+                    PagedPrefillChunkView, PrefillView, SlottedKVCache,
+                    _unwrap)
 from .pages import PageAllocator, PagePoolExhausted
-from .sampling import TOP_K_MAX, sample
+from .sampling import TOP_K_MAX, sample, spec_accept
 
 __all__ = ["DecodeEngine", "PagePoolExhausted", "PrefillTask",
            "prefill_buckets_for"]
@@ -124,7 +154,8 @@ class DecodeEngine:
     def __init__(self, model, num_slots=4, max_len=None, cache_dtype=None,
                  min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True,
                  paged=True, page_size=64, num_pages=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, kv_dtype=None, spec_k=0,
+                 spec_ngram=3):
         cfg = model.config
         self.model = model
         self.num_slots = int(num_slots)
@@ -148,21 +179,59 @@ class DecodeEngine:
         self._head_dim = cfg.hidden_size // cfg.num_attention_heads
         self._layers = cfg.num_hidden_layers
         self._cache_dtype = jnp.dtype(cache_dtype)
+        if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
+            raise ValueError("kv_dtype %r unsupported (int8 only; the "
+                             "scale plumbing is fp8-ready)" % (kv_dtype,))
+        self.kv_dtype = (jnp.dtype(jnp.int8) if kv_dtype is not None
+                         else self._cache_dtype)
+        self._quantized = kv_dtype is not None
+        # opt-in quant-error gauge: the flag is read ONCE here — it
+        # changes the traced entries (an extra carried scalar + output),
+        # so toggling the env var mid-process must not retrace
+        self._track_qerr = bool(self._quantized and os.environ.get(
+            "PADDLE_TPU_METRICS_KV_QUANT_ERROR", "0") == "1")
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if self.spec_k and not self.paged:
+            raise ValueError(
+                "speculative decode runs on the paged engine (spec_k "
+                "with paged=False is not supported — the slotted layout "
+                "is the A/B baseline)")
+        if self.spec_k >= self.max_len:
+            raise ValueError("spec_k %d must be < max_len %d"
+                             % (self.spec_k, self.max_len))
         self._base_key = jax.random.key(int(seed))
         self._rng_step = 0
         # metric handles, fetched once (no-op singletons when disabled)
         self._m_pool = _metrics.gauge("serving.page_pool_used")
         self._m_cow = _metrics.counter("serving.cow_copies")
+        self._m_qerr = _metrics.gauge("serving.kv_quant_error")
         # decode KV-read accounting (the bench's kv_bytes_per_token A/B):
-        # per decode step, `paged_rows` accrues the rows a length-aware
-        # paged schedule reads (mapped pages) vs `flat_rows`, the slotted
-        # slots*max_len bound
+        # per decode/verify step, `paged_rows` accrues the rows a
+        # length-aware paged schedule reads (mapped pages, ONE sweep per
+        # step however many tokens the step commits) vs `flat_rows`, the
+        # slotted slots*max_len PER-TOKEN bound — so speculative steps
+        # show the read amortization and int8 halves the per-row cost
+        # (row_bytes accounts codes + scales)
         self.kv_stats = {"tokens": 0, "paged_rows": 0, "flat_rows": 0}
+        # speculative accounting: steps = verify iterations, proposed =
+        # k per active lane, accepted = accepted draft tokens (the
+        # bench's accepted_tokens_per_step = accepted/steps — the EXTRA
+        # tokens per verify iteration beyond the baseline one-per-slot)
+        self.spec_stats = {"steps": 0, "proposed": 0, "accepted": 0}
         if self.paged:
             self._init_paged(cfg, page_size, num_pages, prefill_chunk,
                              donate)
         else:
             self._init_slotted(cfg, min_bucket, donate)
+
+    def _kv_dtype_arg(self):
+        return "int8" if self._quantized else None
+
+    def _cache_scale_args(self):
+        return (self.cache.k_scale, self.cache.v_scale)
 
     # ------------------------------------------------------------------
     # slotted mode (PR 5 layout — kept for A/B and parity)
@@ -172,31 +241,39 @@ class DecodeEngine:
         self.buckets = prefill_buckets_for(self.max_len, min_bucket)
         self.prompt_cap = self.buckets[-1]
         model, k_max = self.model, self.top_k_max
+        track_qerr = self._track_qerr
         self.cache = SlottedKVCache.create(
             self.num_slots, self._layers, self.max_len, self._heads,
-            self._head_dim, self._cache_dtype)
+            self._head_dim, self._cache_dtype,
+            kv_dtype=self._kv_dtype_arg())
 
-        def decode_fn(state, cache_k, cache_v, lengths, tokens, active,
-                      key, temps, top_ks, top_ps):
+        def decode_fn(state, cache_k, cache_v, k_scale, v_scale, lengths,
+                      tokens, active, key, temps, top_ks, top_ps):
             """One batched decode iteration over every slot."""
             model.eval()   # trace-time: cached decode is inference-only
-            view = DecodeView(SlottedKVCache(cache_k, cache_v, lengths),
-                              active=active)
+            view = DecodeView(
+                SlottedKVCache(cache_k, cache_v, lengths,
+                               k_scale=k_scale, v_scale=v_scale),
+                active=active, track_quant_err=track_qerr)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
             logits = logits[:, -1, :]
             next_tok = sample(logits, key, temps, top_ks, top_ps, k_max)
             out = view.finalize()
-            return next_tok, logits, out.k, out.v, out.lengths
+            return (next_tok, logits, out.k, out.v, out.k_scale,
+                    out.v_scale, out.lengths, view.quant_err)
 
         def prefill_fn(state, tokens, slot, true_len, cache_k, cache_v,
-                       lengths, key, temp, top_k, top_p):
+                       k_scale, v_scale, lengths, key, temp, top_k,
+                       top_p):
             """Prefill one bucketed sequence into ``slot`` and sample the
             first generated token from the last REAL position."""
             model.eval()
-            view = PrefillView(SlottedKVCache(cache_k, cache_v, lengths),
-                               slot, true_len)
+            view = PrefillView(
+                SlottedKVCache(cache_k, cache_v, lengths,
+                               k_scale=k_scale, v_scale=v_scale),
+                slot, true_len)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
@@ -208,14 +285,18 @@ class DecodeEngine:
             tok = sample(last, key, temp[None], top_k[None], top_p[None],
                          k_max)[0]
             out = view.finalize()
-            return tok, last[0], out.k, out.v, out.lengths
+            return (tok, last[0], out.k, out.v, out.k_scale, out.v_scale,
+                    out.lengths)
 
         # hooks for the trace-tier audit (TPU501-505): the registry lowers
         # the un-jitted fns with keep_unused=True at these donate_argnums
+        q = self._quantized
         self._decode_fn = decode_fn
-        self._decode_donate_argnums = (1, 2, 3) if donate else ()
+        self._decode_donate_argnums = \
+            ((1, 2, 5) + ((3, 4) if q else ())) if donate else ()
         self._prefill_fn = prefill_fn
-        self._prefill_donate_argnums = (4, 5, 6) if donate else ()
+        self._prefill_donate_argnums = \
+            ((4, 5, 8) + ((6, 7) if q else ())) if donate else ()
         # recompile watchdog (observability.watchdog): decode is the
         # compile-ONCE entry — a second program is PR 5's silent-retrace
         # bug class and warns (raises under PADDLE_TPU_STRICT_COMPILE=1);
@@ -253,35 +334,71 @@ class DecodeEngine:
         self.cache = PagedKVCache.create(
             self.num_pages, self._layers, self.page_size, self._heads,
             self._head_dim, self.num_slots, self.max_pages,
-            self._cache_dtype)
+            self._cache_dtype, kv_dtype=self._kv_dtype_arg())
         # hoist everything the traced closures need: capturing `self`
         # would pin the whole engine (buffers included) to the jitted fns
         model, k_max, L_max = self.model, self.top_k_max, self.max_len
+        track_qerr = self._track_qerr
+        quantized = self._quantized
 
-        def decode_fn(state, cache_k, cache_v, lengths, page_table,
-                      tokens, active, key, temps, top_ks, top_ps):
+        def decode_fn(state, cache_k, cache_v, k_scale, v_scale, lengths,
+                      page_table, tokens, active, key, temps, top_ks,
+                      top_ps):
             """One batched decode iteration over every slot (paged)."""
             model.eval()
             view = PagedDecodeView(
-                PagedKVCache(cache_k, cache_v, page_table, lengths),
-                active=active, max_len=L_max)
+                PagedKVCache(cache_k, cache_v, page_table, lengths,
+                             k_scale=k_scale, v_scale=v_scale),
+                active=active, max_len=L_max, track_quant_err=track_qerr)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
             logits = logits[:, -1, :]
             next_tok = sample(logits, key, temps, top_ks, top_ps, k_max)
             out = view.finalize()
-            return next_tok, logits, out.k, out.v, out.lengths
+            return (next_tok, logits, out.k, out.v, out.k_scale,
+                    out.v_scale, out.lengths, view.quant_err)
+
+        def verify_fn(state, cache_k, cache_v, k_scale, v_scale, lengths,
+                      page_table, tokens, active, key, temps, top_ks,
+                      top_ps):
+            """The speculative batched verify: ``tokens: (slots, k+1)``
+            = [last committed token, draft_1..draft_k].  ONE forward
+            scores every position; accept/resample and the rejected-
+            draft length rollback run in-program."""
+            model.eval()
+            view = PagedDecodeView(
+                PagedKVCache(cache_k, cache_v, page_table, lengths,
+                             k_scale=k_scale, v_scale=v_scale),
+                active=active, max_len=L_max, track_quant_err=track_qerr)
+            from ..jit import functional_call
+            (logits, _), _ = functional_call(model, state, Tensor(tokens),
+                                             cache=view)
+            logits = _unwrap(logits).astype(jnp.float32)    # (S, k+1, V)
+            # acceptance never reaches past the cache's append capacity:
+            # position j's logits are valid only while n + j < max_len
+            a_cap = jnp.asarray(L_max, jnp.int32) \
+                - jnp.ones((), jnp.int32) - lengths
+            emitted, counts = spec_accept(logits, _unwrap(tokens), key,
+                                          temps, top_ks, top_ps, k_max,
+                                          max_accept=a_cap)
+            # rejected drafts roll back IN-PROGRAM: lengths advance by
+            # accepted+1 only; the dead tail-page rows beyond are
+            # overwritten by the next step's appends
+            out = view.finalize(advance=counts)
+            return (emitted, counts, logits, out.k, out.v, out.k_scale,
+                    out.v_scale, out.lengths, view.quant_err)
 
         def prefill_chunk_fn(state, tokens, slot, n_before, n_valid,
-                             cache_k, cache_v, lengths, page_table, key,
-                             temp, top_k, top_p):
+                             cache_k, cache_v, k_scale, v_scale, lengths,
+                             page_table, key, temp, top_k, top_p):
             """One fixed-size chunk of one slot's prompt.  Samples a
             token from the chunk's LAST REAL position — meaningful (and
             used) only on the final chunk."""
             model.eval()
             view = PagedPrefillChunkView(
-                PagedKVCache(cache_k, cache_v, page_table, lengths),
+                PagedKVCache(cache_k, cache_v, page_table, lengths,
+                             k_scale=k_scale, v_scale=v_scale),
                 slot, n_before, n_valid)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
@@ -294,11 +411,13 @@ class DecodeEngine:
             tok = sample(last, key, temp[None], top_k[None], top_p[None],
                          k_max)[0]
             out = view.finalize()
-            return tok, last[0], out.k, out.v, out.lengths
+            return (tok, last[0], out.k, out.v, out.k_scale, out.v_scale,
+                    out.lengths)
 
-        def cow_copy_fn(cache_k, cache_v, src, dst):
-            """Copy one page (all layers) src -> dst: the copy-on-write
-            that un-shares a prefix page before a write targets it."""
+        def cow_copy_fn(cache_k, cache_v, k_scale, v_scale, src, dst):
+            """Copy one page (all layers — scale rows included for the
+            int8 pool) src -> dst: the copy-on-write that un-shares a
+            prefix page before a write targets it."""
             src = jnp.asarray(src, jnp.int32)
             dst = jnp.asarray(dst, jnp.int32)
             k_page = jax.lax.dynamic_index_in_dim(cache_k, src, axis=0)
@@ -307,19 +426,43 @@ class DecodeEngine:
             start = (dst, zero, zero, zero, zero)
             cache_k = jax.lax.dynamic_update_slice(cache_k, k_page, start)
             cache_v = jax.lax.dynamic_update_slice(cache_v, v_page, start)
-            return cache_k, cache_v
+            if quantized:
+                ks_page = jax.lax.dynamic_index_in_dim(k_scale, src,
+                                                       axis=0)
+                vs_page = jax.lax.dynamic_index_in_dim(v_scale, src,
+                                                       axis=0)
+                k_scale = jax.lax.dynamic_update_slice(k_scale, ks_page,
+                                                       start[:-1])
+                v_scale = jax.lax.dynamic_update_slice(v_scale, vs_page,
+                                                       start[:-1])
+            return cache_k, cache_v, k_scale, v_scale
 
+        q = self._quantized
         self._decode_fn = decode_fn
-        self._decode_donate_argnums = (1, 2, 3) if donate else ()
+        self._decode_donate_argnums = \
+            ((1, 2, 5) + ((3, 4) if q else ())) if donate else ()
+        self._verify_fn = verify_fn
+        self._verify_donate_argnums = self._decode_donate_argnums
         self._prefill_chunk_fn = prefill_chunk_fn
-        self._prefill_chunk_donate_argnums = (5, 6, 7) if donate else ()
+        self._prefill_chunk_donate_argnums = \
+            ((5, 6, 9) + ((7, 8) if q else ())) if donate else ()
         self._cow_fn = cow_copy_fn
-        self._cow_donate_argnums = (0, 1) if donate else ()
+        self._cow_donate_argnums = \
+            ((0, 1) + ((2, 3) if q else ())) if donate else ()
         from ..observability.watchdog import watch
         self._decode = watch(
             "serving.decode",
             jax.jit(decode_fn, donate_argnums=self._decode_donate_argnums),
             expected=1)
+        self._verify = None
+        if self.spec_k:
+            # fixed draft length k => ONE static verify program, full
+            # stop — all-accept and all-reject are traced-value paths
+            self._verify = watch(
+                "serving.spec_verify",
+                jax.jit(verify_fn,
+                        donate_argnums=self._verify_donate_argnums),
+                expected=1)
         # ONE chunk shape => ONE program (vs log2(max_len) buckets)
         self._prefill_chunk = watch(
             "serving.prefill_chunk",
@@ -358,17 +501,20 @@ class DecodeEngine:
         """Free every slot (paged: pages return to the pool and prefix
         hashes are purged; slot contents are overwritten lazily)."""
         self.kv_stats = {"tokens": 0, "paged_rows": 0, "flat_rows": 0}
+        self.spec_stats = {"steps": 0, "proposed": 0, "accepted": 0}
+        c = self.cache
         if self.paged:
             self._alloc.reset()
             self._len_host[:] = 0
             self._m_pool.set(0)
             self.cache = PagedKVCache(
-                self.cache.k, self.cache.v, self._alloc.device_table(),
-                jnp.zeros((self.num_slots,), jnp.int32))
+                c.k, c.v, self._alloc.device_table(),
+                jnp.zeros((self.num_slots,), jnp.int32),
+                k_scale=c.k_scale, v_scale=c.v_scale)
         else:
             self.cache = SlottedKVCache(
-                self.cache.k, self.cache.v,
-                jnp.zeros((self.num_slots,), jnp.int32))
+                c.k, c.v, jnp.zeros((self.num_slots,), jnp.int32),
+                k_scale=c.k_scale, v_scale=c.v_scale)
 
     def reseed(self, seed):
         """Restart the threaded key stream: after ``reseed(s)`` the next
@@ -393,6 +539,12 @@ class DecodeEngine:
         self._rng_step += 1
         return jax.random.fold_in(self._base_key, self._rng_step)
 
+    def _set_quant_err(self, qerr):
+        if qerr is not None:
+            # opt-in: one device sync per step (same caveat as the
+            # train.grad_norm gauge)
+            self._m_qerr.set(float(np.asarray(qerr)))
+
     # -- paged page bookkeeping (host side) --------------------------------
 
     def _set_length(self, slot, n):
@@ -402,7 +554,8 @@ class DecodeEngine:
         c = self.cache
         self.cache = PagedKVCache(
             c.k, c.v, c.page_table,
-            c.lengths.at[int(slot)].set(int(n)))
+            c.lengths.at[int(slot)].set(int(n)),
+            k_scale=c.k_scale, v_scale=c.v_scale)
 
     def free_slot(self, slot):
         """Release a retired slot's pages (refcounted) and zero its
@@ -429,13 +582,14 @@ class DecodeEngine:
         private page (raises PagePoolExhausted when the pool is dry)."""
         new_pid = self._alloc.alloc()
         old_pid = int(self._alloc.table[int(slot), int(idx)])
+        c = self.cache
         with x64_scope(False):
-            k, v = self._cow(self.cache.k, self.cache.v,
-                             jnp.asarray(old_pid, jnp.int32),
-                             jnp.asarray(new_pid, jnp.int32))
+            k, v, ks, vs = self._cow(c.k, c.v, c.k_scale, c.v_scale,
+                                     jnp.asarray(old_pid, jnp.int32),
+                                     jnp.asarray(new_pid, jnp.int32))
         self._alloc.remap(int(slot), int(idx), new_pid)
-        self.cache = PagedKVCache(k, v, self.cache.page_table,
-                                  self.cache.lengths)
+        self.cache = PagedKVCache(k, v, c.page_table, c.lengths,
+                                  k_scale=ks, v_scale=vs)
         self._m_cow.inc()
 
     def _ensure_write_range(self, slot, start, stop):
@@ -451,13 +605,15 @@ class DecodeEngine:
                 self._cow_page(slot, idx)
         self._m_pool.set(self._alloc.pages_used())
 
-    def ensure_decode_ready(self, active):
-        """Pre-step page bookkeeping for one batched decode: every
-        active slot's append position must land in a mapped, PRIVATE
-        page.  Returns the first slot index that could not get a page
-        (pool dry — evict and retry), or None when ready."""
+    def ensure_decode_ready(self, active, steps=1):
+        """Pre-step page bookkeeping for one batched decode (or verify:
+        ``steps = spec_k + 1`` append positions per slot): every active
+        slot's append range must land in mapped, PRIVATE pages.
+        Returns the first slot index that could not get a page (pool
+        dry — evict and retry), or None when ready."""
         if not self.paged:
             return None
+        steps = int(steps)
         for i, on in enumerate(active):
             if not on:
                 continue
@@ -465,7 +621,8 @@ class DecodeEngine:
             if p >= self.max_len:
                 continue        # scheduler retires this slot (cache_full)
             try:
-                self._ensure_write_range(i, p, p + 1)
+                self._ensure_write_range(i, p, min(p + steps,
+                                                   self.max_len))
             except PagePoolExhausted:
                 return i
         return None
@@ -537,18 +694,18 @@ class DecodeEngine:
         # discipline as the Pallas kernel entries; asserted over the
         # compiled HLO by tests/test_serving.py)
         with x64_scope(False), _eval_scope(self.model):
-            tok, logits, k, v, lengths = self._prefill_chunk(
+            tok, logits, k, v, ks, vs, lengths = self._prefill_chunk(
                 self.state, jnp.asarray(padded),
                 jnp.asarray(task.slot, jnp.int32),
                 jnp.asarray(task.pos, jnp.int32),
                 jnp.asarray(n_valid, jnp.int32),
-                self.cache.k, self.cache.v, self.cache.lengths,
-                self._alloc.device_table(), key,
+                self.cache.k, self.cache.v, *self._cache_scale_args(),
+                self.cache.lengths, self._alloc.device_table(), key,
                 jnp.asarray(task.temperature, jnp.float32),
                 jnp.asarray(min(task.top_k, self.top_k_max), jnp.int32),
                 jnp.asarray(task.top_p, jnp.float32))
         self.cache = PagedKVCache(k, v, self._alloc.device_table(),
-                                  lengths)
+                                  lengths, k_scale=ks, v_scale=vs)
         task.pos += n_valid
         task.chunks_run += 1
         self._len_host[task.slot] = task.pos
@@ -587,15 +744,16 @@ class DecodeEngine:
         padded[0, :n] = ids
         # x64/eval scopes: see prefill_step()
         with x64_scope(False), _eval_scope(self.model):
-            tok, logits, k, v, lengths = self._prefill(
+            tok, logits, k, v, ks, vs, lengths = self._prefill(
                 self.state, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(n, jnp.int32), self.cache.k, self.cache.v,
+                *self._cache_scale_args(),
                 self.cache.lengths, self._next_key(),
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(min(int(top_k), self.top_k_max), jnp.int32),
                 jnp.asarray(top_p, jnp.float32))
-        self.cache = SlottedKVCache(k, v, lengths)
+        self.cache = SlottedKVCache(k, v, lengths, k_scale=ks, v_scale=vs)
         return int(tok), logits
 
     # -- decode ------------------------------------------------------------
@@ -621,11 +779,11 @@ class DecodeEngine:
         # s64/f64-free and the caller's train/eval mode untouched
         with x64_scope(False), _eval_scope(self.model):
             # both layouts share one call shape; paged inserts the page
-            # table after lengths (donated argnums 1-3 are identical)
+            # table after lengths (donated argnums are identical)
             table = (self._alloc.device_table(),) if self.paged else ()
-            tok, logits, k, v, lengths = self._decode(
+            tok, logits, k, v, ks, vs, lengths, qerr = self._decode(
                 self.state, self.cache.k, self.cache.v,
-                self.cache.lengths, *table,
+                *self._cache_scale_args(), self.cache.lengths, *table,
                 jnp.asarray(toks), jnp.asarray(active_np),
                 self._next_key(),
                 jnp.asarray(np.asarray(temperature, np.float32)),
@@ -636,7 +794,7 @@ class DecodeEngine:
             self.kv_stats["flat_rows"] += self.num_slots * self.max_len
             if self.paged:
                 self.cache = PagedKVCache(k, v, self._alloc.device_table(),
-                                          lengths)
+                                          lengths, k_scale=ks, v_scale=vs)
                 # mirror the program's finalize exactly: lengths advance
                 # for every active lane but clamp at max_len — a direct
                 # caller keeping a full lane active has its append
@@ -649,8 +807,77 @@ class DecodeEngine:
                     self._alloc.mapped_rows_total()
             else:
                 # the slotted read bound IS the flat slots*max_len sweep
-                self.cache = SlottedKVCache(k, v, lengths)
+                self.cache = SlottedKVCache(k, v, lengths,
+                                            k_scale=ks, v_scale=vs)
+        self._set_quant_err(qerr)
         return np.asarray(tok), logits
+
+    def decode_spec(self, tokens, drafts, active, temperature, top_k,
+                    top_p, pages_ready=False):
+        """One speculative verify step (paged engines with ``spec_k``).
+
+        ``tokens``: (num_slots,) last committed token per slot;
+        ``drafts``: (num_slots, spec_k) int32 proposals (see
+        :func:`.spec.propose` — quality moves throughput, never
+        correctness).  Returns ``(emitted, counts, logits)``: emitted
+        (num_slots, spec_k+1) np int32 whose row ``b`` holds
+        ``counts[b]`` usable tokens — the accepted drafts plus one
+        sampled/corrected token; logits (slots, k+1, vocab) stays on
+        device.  Each slot's cache length advanced by ``counts[b]``
+        (committed context; the final emitted token is appended by the
+        NEXT step, exactly like :meth:`decode`)."""
+        if not self.spec_k:
+            raise RuntimeError("decode_spec needs an engine built with "
+                               "spec_k > 0")
+        S, k = self.num_slots, self.spec_k
+        toks = np.asarray(tokens, np.int32).reshape(S, 1)
+        drafts_np = np.asarray(drafts, np.int32).reshape(S, k)
+        active_np = np.asarray(active, bool).reshape(S)
+        if not pages_ready:
+            blocked = self.ensure_decode_ready(active_np, steps=k + 1)
+            if blocked is not None:
+                raise PagePoolExhausted(
+                    "no free page for slot %d's speculative appends — "
+                    "evict a slot (the scheduler does this "
+                    "refcount-aware)" % blocked)
+        step_toks = np.concatenate([toks, drafts_np], axis=1)  # (S, k+1)
+        with x64_scope(False), _eval_scope(self.model):
+            emitted, counts, logits, kk, v, ks, vs, lengths, qerr = \
+                self._verify(
+                    self.state, self.cache.k, self.cache.v,
+                    *self._cache_scale_args(), self.cache.lengths,
+                    self._alloc.device_table(),
+                    jnp.asarray(step_toks), jnp.asarray(active_np),
+                    self._next_key(),
+                    jnp.asarray(np.asarray(temperature, np.float32)),
+                    jnp.asarray(np.minimum(np.asarray(top_k, np.int32),
+                                           self.top_k_max)),
+                    jnp.asarray(np.asarray(top_p, np.float32)))
+            self.cache = PagedKVCache(kk, v, self._alloc.device_table(),
+                                      lengths, k_scale=ks, v_scale=vs)
+        counts_np = np.asarray(counts, np.int64)
+        # mirror the program's rollback exactly: advance by the
+        # accepted+1 commit, clamped at max_len
+        self._len_host[active_np] += counts_np[active_np]
+        np.minimum(self._len_host, self.max_len, out=self._len_host)
+        n_active = int(active_np.sum())
+        emitted_total = int(counts_np[active_np].sum())
+        self.spec_stats["steps"] += 1
+        self.spec_stats["proposed"] += k * n_active
+        self.spec_stats["accepted"] += emitted_total - n_active
+        # read accounting: ONE mapped-pages sweep serves every token the
+        # step commits (the amortization lever).  The flat baseline is
+        # what a slotted NON-spec engine would read for the same tokens:
+        # one slots*max_len sweep per single-token step, n_active tokens
+        # per sweep — emitted_total/n_active sweeps (same normalization
+        # as the plain-decode accounting, so A/B lines compare).
+        self.kv_stats["tokens"] += emitted_total
+        if n_active:
+            self.kv_stats["flat_rows"] += (self.num_slots * self.max_len
+                                           * emitted_total) / n_active
+        self.kv_stats["paged_rows"] += self._alloc.mapped_rows_total()
+        self._set_quant_err(qerr)
+        return np.asarray(emitted), counts_np.astype(np.int64), logits
 
     def slot_lengths(self):
         """Per-slot valid lengths.  Paged mode serves the host mirror —
@@ -659,15 +886,26 @@ class DecodeEngine:
             return self._len_host.copy()
         return np.asarray(self.cache.lengths)
 
+    def kv_row_bytes(self):
+        """Bytes one K+V row (all layers, all heads) costs a decode
+        read.  int8: codes + the per-(row, head) f32 scale — the honest
+        read bound, not just the code bytes."""
+        if self._quantized:
+            per_head = self._head_dim * 1 + 4
+        else:
+            per_head = self._head_dim * self._cache_dtype.itemsize
+        return self._layers * self._heads * per_head * 2
+
     def kv_bytes_per_token(self):
         """Observed decode KV-read accounting: bytes per generated token
         under (a) the paged true-length bound and (b) the slotted
         ``slots*max_len`` bound — the bench's A/B line.  Row cost covers
-        K+V across all layers.  Slotted engines report only ``flat``
-        (their real read bound): a fabricated ``paged: 0.0`` would read
-        as a datum in the A/B trajectory."""
-        row = (self._layers * self._heads * self._head_dim * 2
-               * self._cache_dtype.itemsize)
+        K+V across all layers (int8: codes + scales).  Slotted engines
+        report only ``flat`` (their real read bound): a fabricated
+        ``paged: 0.0`` would read as a datum in the A/B trajectory.
+        Speculative steps amortize ONE paged sweep over every committed
+        token, so the paged line reflects both multiplicative levers."""
+        row = self.kv_row_bytes()
         t = self.kv_stats["tokens"]
         out = {"flat": (float(self.num_slots * self.max_len * row)
                         if not t    # no decode yet: the static bound
@@ -683,6 +921,14 @@ class DecodeEngine:
     def decode_compile_count(self):
         """Number of programs the decode jit holds — MUST stay 1."""
         return int(self._decode._cache_size())
+
+    @property
+    def verify_compile_count(self):
+        """Programs the speculative verify jit holds — MUST stay <= 1
+        (0 until the first verify call; fixed k keeps it there)."""
+        if not self.spec_k:
+            return 0
+        return int(self._verify._cache_size())
 
     @property
     def prefill_compile_count(self):
@@ -701,11 +947,25 @@ class DecodeEngine:
         common = (jnp.zeros((s, 1), jnp.int32), jnp.ones((s,), bool),
                   jax.random.key(0), jnp.ones((s,), jnp.float32),
                   jnp.zeros((s,), jnp.int32), jnp.ones((s,), jnp.float32))
+        head = (self.state, self.cache.k, self.cache.v,
+                *self._cache_scale_args(), self.cache.lengths)
         if self.paged:
-            return (self.state, self.cache.k, self.cache.v,
-                    self.cache.lengths, self._alloc.device_table()) + common
+            return head + (self._alloc.device_table(),) + common
+        return head + common
+
+    def verify_trace_args(self):
+        """Argument avals for the speculative verify entry (paged +
+        spec_k engines)."""
+        if not self.spec_k:
+            raise RuntimeError("verify_trace_args needs spec_k > 0")
+        s = self.num_slots
         return (self.state, self.cache.k, self.cache.v,
-                self.cache.lengths) + common
+                *self._cache_scale_args(), self.cache.lengths,
+                self._alloc.device_table(),
+                jnp.zeros((s, self.spec_k + 1), jnp.int32),
+                jnp.ones((s,), bool), jax.random.key(0),
+                jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
+                jnp.ones((s,), jnp.float32))
 
     def prefill_trace_args(self, bucket=None):
         if self.paged:
@@ -714,16 +974,21 @@ class DecodeEngine:
         b = int(bucket or self.buckets[0])
         return (self.state, jnp.zeros((1, b), jnp.int32),
                 jnp.zeros((), jnp.int32), jnp.asarray(b, jnp.int32),
-                self.cache.k, self.cache.v, self.cache.lengths,
-                jax.random.key(0), jnp.ones((), jnp.float32),
-                jnp.zeros((), jnp.int32), jnp.ones((), jnp.float32))
+                self.cache.k, self.cache.v, *self._cache_scale_args(),
+                self.cache.lengths, jax.random.key(0),
+                jnp.ones((), jnp.float32), jnp.zeros((), jnp.int32),
+                jnp.ones((), jnp.float32))
 
     def prefill_chunk_trace_args(self):
         C = self.prefill_chunk
         return (self.state, jnp.zeros((1, C), jnp.int32),
                 jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
                 jnp.asarray(min(C, self.max_len), jnp.int32),
-                self.cache.k, self.cache.v, self.cache.lengths,
-                self._alloc.device_table(), jax.random.key(0),
-                jnp.ones((), jnp.float32), jnp.zeros((), jnp.int32),
-                jnp.ones((), jnp.float32))
+                self.cache.k, self.cache.v, *self._cache_scale_args(),
+                self.cache.lengths, self._alloc.device_table(),
+                jax.random.key(0), jnp.ones((), jnp.float32),
+                jnp.zeros((), jnp.int32), jnp.ones((), jnp.float32))
+
+    def cow_trace_args(self):
+        return (self.cache.k, self.cache.v, *self._cache_scale_args(),
+                jnp.zeros((), jnp.int32), jnp.ones((), jnp.int32))

@@ -18,6 +18,23 @@ non-interference — tested).  A prefix-cache hit skips the shared pages
 entirely (the counter ``serving.prefix_hit_pages`` meters it) and a
 fully-cached prompt admits in a single 1-token chunk.
 
+**Speculative decode (``spec_k`` engines — ISSUE 8).**  When the engine
+was built with ``spec_k > 0`` the decode iteration becomes a *verify*
+iteration: for every active slot the scheduler proposes ``spec_k``
+tokens by prompt-lookup over the slot's own ``prompt + generated``
+history (:mod:`.spec` — zero model FLOPs) and ONE compiled verify step
+scores all ``spec_k + 1`` positions, accepting a per-slot prefix and
+sampling one corrective token (``sampling.spec_accept``).  The
+scheduler appends the emitted run, truncating at EOS and the
+``max_new_tokens`` budget (truncation always retires the slot, so the
+host token list and the device length mirror never diverge for live
+slots).  Per-request ``spec_proposed``/``spec_accepted`` land on the
+:class:`RequestResult` and on the ``serving.spec_proposed_tokens``/
+``serving.spec_accepted_tokens`` counter pair (accept rate =
+accepted/proposed).  TPOT keeps meaning seconds per decode-committed
+token: a verify step's wall time is divided across every token it
+emitted.
+
 **Refcount-aware eviction, preemption by recompute.**  When the page
 pool is dry (a decode append or a prefill chunk cannot map a page), the
 victim is the active slot with the MOST unshared pages — freeing it
@@ -59,6 +76,7 @@ import numpy as np
 
 from ..observability import registry as _metrics
 from .engine import PagePoolExhausted
+from .spec import propose as _propose_draft
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
 
@@ -87,12 +105,18 @@ class RequestResult:
     prefix_hit_tokens: int = 0           # tokens served from the prefix
                                          # cache, all admissions (a
                                          # preemption resume's hits count)
+    spec_proposed: int = 0               # draft tokens proposed for this
+                                         # request (spec_k per verify step)
+    spec_accepted: int = 0               # draft tokens the verify step
+                                         # accepted (rate = accepted /
+                                         # proposed; 0/0 when spec off)
 
 
 class _ActiveSlot:
     __slots__ = ("req", "generated", "submit_t", "first_tok_t", "last_t",
                  "decode_s", "decode_steps", "queue_wait", "prefill_task",
-                 "admit_order", "prefix_hit_tokens")
+                 "admit_order", "prefix_hit_tokens", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, req, submit_t, queue_wait, admit_order,
                  prefill_task=None):
@@ -102,16 +126,20 @@ class _ActiveSlot:
         self.first_tok_t = None
         self.last_t = None
         self.decode_s = 0.0
-        self.decode_steps = 0          # timed decode appends only: a
-                                       # preemption resume's prefill-
-                                       # sampled token adds no decode_s,
-                                       # so len(generated)-1 would
-                                       # deflate TPOT
+        self.decode_steps = 0          # timed decode-committed TOKENS
+                                       # only (a verify step counts every
+                                       # token it emitted): a preemption
+                                       # resume's prefill-sampled token
+                                       # adds no decode_s, so
+                                       # len(generated)-1 would deflate
+                                       # TPOT
         self.queue_wait = queue_wait
         self.prefill_task = prefill_task   # None once prefill completed
         self.admit_order = admit_order     # FIFO tie-break for eviction
         self.prefix_hit_tokens = (prefill_task.shared_tokens
                                   if prefill_task is not None else 0)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     def first_token(self, tok, now):
         self.generated.append(int(tok))
@@ -156,6 +184,10 @@ class ContinuousBatchingScheduler:
             "serving.prefill_bucket_hits", ("bucket",))
         self._m_prefix_hits = _metrics.counter("serving.prefix_hit_pages")
         self._m_preempt = _metrics.counter("serving.preemptions")
+        self._m_spec_prop = _metrics.counter(
+            "serving.spec_proposed_tokens")
+        self._m_spec_acc = _metrics.counter(
+            "serving.spec_accepted_tokens")
         self._m_finished = _metrics.counter(
             "serving.finished_requests", ("reason",))
         self._m_occupancy = _metrics.gauge("serving.slot_occupancy")
@@ -197,7 +229,9 @@ class ContinuousBatchingScheduler:
             rid=act.req.rid, tokens=np.asarray(act.generated, np.int32),
             finish_reason=reason, ttft=ttft, tpot=tpot,
             queue_wait=act.queue_wait,
-            prefix_hit_tokens=act.prefix_hit_tokens)
+            prefix_hit_tokens=act.prefix_hit_tokens,
+            spec_proposed=act.spec_proposed,
+            spec_accepted=act.spec_accepted)
         self.slots[idx] = None
         self.engine.free_slot(idx)     # paged: pages back to the pool
         self._preempt_count.pop(act.req.rid, None)
@@ -383,21 +417,24 @@ class ContinuousBatchingScheduler:
     # -- decode ------------------------------------------------------------
 
     def decode_once(self) -> int:
-        """One batched decode iteration over the active (fully-
-        prefilled) slots; returns the number of tokens appended to live
-        requests."""
+        """One batched decode (or speculative verify) iteration over the
+        active (fully-prefilled) slots; returns the number of tokens
+        appended to live requests."""
         def active_mask():
             return [a is not None and a.prefill_task is None
                     for a in self.slots]
 
+        spec_k = int(getattr(self.engine, "spec_k", 0))
         active = active_mask()
         if not any(active):
             return 0
         if self.engine.paged:
-            # pre-step page bookkeeping: every append needs a mapped
-            # private page; pool-dry evicts the max-unshared victim
+            # pre-step page bookkeeping: every append (k+1 of them per
+            # slot for a verify step) needs a mapped private page;
+            # pool-dry evicts the max-unshared victim
             while True:
-                blocked = self.engine.ensure_decode_ready(active)
+                blocked = self.engine.ensure_decode_ready(
+                    active, steps=spec_k + 1)
                 if blocked is None:
                     break
                 self._evict_for_pages(blocked)
@@ -409,6 +446,7 @@ class ContinuousBatchingScheduler:
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.ones((S,), np.float32)
+        drafts = np.zeros((S, max(spec_k, 1)), np.int32)
         for i, act in enumerate(self.slots):
             if not active[i]:
                 continue
@@ -416,26 +454,61 @@ class ContinuousBatchingScheduler:
             temps[i] = act.req.temperature
             top_ks[i] = act.req.top_k
             top_ps[i] = act.req.top_p
+            if spec_k:
+                # self-speculative prompt-lookup draft over the slot's
+                # OWN history — host-side, zero model FLOPs; a miss just
+                # pads (the verify step then emits one token, like decode)
+                hist = np.concatenate(
+                    [act.req.prompt,
+                     np.asarray(act.generated, np.int32)])
+                drafts[i], _hit = _propose_draft(
+                    hist, spec_k, getattr(self.engine, "spec_ngram", 3))
         t0 = time.perf_counter()
-        next_tok, _logits = self.engine.decode(tokens, active, temps,
-                                               top_ks, top_ps,
-                                               pages_ready=True)
+        if spec_k:
+            emitted, counts, _logits = self.engine.decode_spec(
+                tokens, drafts, active, temps, top_ks, top_ps,
+                pages_ready=True)
+        else:
+            next_tok, _logits = self.engine.decode(tokens, active, temps,
+                                                   top_ks, top_ps,
+                                                   pages_ready=True)
         t1 = time.perf_counter()
         lengths = self.engine.slot_lengths()   # ONE fetch per step
         n = 0
+        spec_prop = spec_acc = 0               # per-ITERATION counter incs
         for i, act in enumerate(self.slots):
             if not active[i]:
                 continue
-            act.generated.append(int(next_tok[i]))
+            if spec_k:
+                emit = [int(t) for t in emitted[i, :int(counts[i])]]
+                act.spec_proposed += spec_k
+                act.spec_accepted += len(emit) - 1
+                spec_prop += spec_k
+                spec_acc += len(emit) - 1
+                # truncate at the budget and at EOS — both retire the
+                # slot in _check_finished, so a truncated host token
+                # list never belongs to a live (still-decoding) slot
+                room = act.req.max_new_tokens - len(act.generated)
+                emit = emit[:max(room, 0)]
+                if act.req.eos_token_id is not None:
+                    eos = int(act.req.eos_token_id)
+                    if eos in emit:
+                        emit = emit[:emit.index(eos) + 1]
+            else:
+                emit = [int(next_tok[i])]
+            act.generated.extend(emit)
             act.decode_s += t1 - t0
-            act.decode_steps += 1
+            act.decode_steps += len(emit)   # TPOT = secs per token
             act.last_t = t1
-            n += 1
+            n += len(emit)
             self._check_finished(i, lengths)
         # per-ITERATION metrics (not per token): one histogram observe,
         # one counter inc, one gauge set per batched step
         self._m_decode_step.observe(t1 - t0)
         self._m_tokens.inc(n)
+        if spec_prop:
+            self._m_spec_prop.inc(spec_prop)
+            self._m_spec_acc.inc(spec_acc)
         self._m_occupancy.set(sum(a is not None for a in self.slots))
         return n
 

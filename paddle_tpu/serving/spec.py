@@ -1,0 +1,56 @@
+"""Self-speculative drafting — prompt-lookup (n-gram) proposals.
+
+The cheapest useful draft model is the request's OWN token history:
+natural-language generation constantly re-emits spans it has already
+seen (copied entities, quoted context, code identifiers, the system
+prompt's phrasing), so "find the most recent earlier occurrence of the
+trailing n-gram and propose what followed it" (prompt-lookup decoding;
+the n-gram analogue of Leviathan-style drafting with a zero-FLOP draft
+model) accepts long runs exactly where decode is cheapest to amortize.
+
+Everything here is host/numpy work over the slot's `prompt + generated`
+history — no model FLOPs, no device traffic.  Draft QUALITY only moves
+throughput, never correctness: the batched verify step accepts/resamples
+against the real model distribution (``sampling.spec_accept``), so a
+miss just degenerates that iteration to one token, same as plain decode.
+Proposals are always exactly ``k`` tokens (the verify program is one
+fixed shape): short matches and no-match slots are padded by repeating
+the last token.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["propose"]
+
+
+def propose(history, k, max_ngram=3):
+    """Draft ``k`` tokens for a slot from its own token history.
+
+    Tries the longest trailing n-gram first (``n = max_ngram .. 1``),
+    scanning for its MOST RECENT earlier occurrence that has at least
+    one continuation token; proposes the ``k`` tokens that followed,
+    padded by repeating the history's last token.  Returns
+    ``(draft (k,) int32, hit bool)`` — ``hit`` False means every
+    position is pad (the verify step then degenerates to one token).
+    """
+    h = np.asarray(history, np.int32).reshape(-1)
+    k = int(k)
+    n_h = int(h.size)
+    fill = int(h[-1]) if n_h else 0
+    draft = np.full((k,), fill, np.int32)
+    if n_h < 2:
+        return draft, False
+    for n in range(min(int(max_ngram), n_h - 1), 0, -1):
+        tail = h[n_h - n:]
+        # windows over h[:-1]: starts 0..n_h-1-n, so every match has at
+        # least one continuation token and the trailing n-gram itself
+        # (start n_h-n) is excluded
+        windows = np.lib.stride_tricks.sliding_window_view(h[:-1], n)
+        starts = np.nonzero((windows == tail).all(axis=1))[0]
+        if starts.size:
+            i = int(starts[-1])                    # most recent match
+            cont = h[i + n:i + n + k]
+            draft[:cont.size] = cont
+            return draft, True
+    return draft, False

@@ -59,7 +59,11 @@ import _jax_compat
            "grad-transpose psum placement (grads come out exactly 2x over "
            "'dp' — measured, see tests/_jax_compat.py).  Newer jax infers "
            "the replication and runs the CHECKED program; skipping beats "
-           "green-lighting a known-miscompiled gradient.")
+           "green-lighting a known-miscompiled gradient.  Re-audited in "
+           "the ISSUE-8 skip sweep: still 0.4.37-red — the strict build "
+           "raises the same static-inference error at trace time and the "
+           "relaxed build still doubles the 'dp' grads, so neither "
+           "execution path is convertible to a live test on this pin.")
 def test_dp_mp_pp_one_program():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

@@ -644,7 +644,8 @@ def test_bench_schema_rejects_malformed_lines():
 
 
 def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
-                metric="decode_tokens_per_sec", layout="paged"):
+                metric="decode_tokens_per_sec", layout="paged",
+                kv_dtype=None, spec=None):
     line = {"metric": metric, "value": value, "unit": "tok/s",
             "cache_layout": layout,
             "compile_counts": {"decode": decode_compiles, "prefill": 1},
@@ -652,6 +653,10 @@ def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
                         "compile_counts":
                             {"serving.decode": decode_compiles}},
             "config": {"backend": backend, "model": "tiny"}}
+    if kv_dtype is not None:
+        line["kv_dtype"] = kv_dtype
+    if spec is not None:
+        line["spec"] = spec
     p = tmp_path / name
     p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
                              "parsed": line}))
@@ -721,6 +726,46 @@ def test_trajectory_mode_separates_layouts_and_writes(tmp_path):
     fails = bs.check_trajectory(interleaved)
     assert len(fails) == 1 and "regression" in fails[0]
     assert "BENCH_decode_r14" in fails[0] and "BENCH_decode_r12" in fails[0]
+
+
+def test_trajectory_cursor_keys_on_kv_dtype_and_spec(tmp_path):
+    """ISSUE-8 cursor key: the A/B matrix interleaves (kv_dtype, spec)
+    lines in one trajectory — int8 is legitimately differently-paced
+    than bf16 and a spec line than a non-spec one, so each combination
+    keeps its OWN regression cursor; and a real like-for-like drop
+    still fails with matrix lines in between."""
+    bs = _bench_schema()
+    # int8 slower than the preceding bf16 line: different legs, no fail
+    mixed = [
+        _traj_entry(tmp_path, "BENCH_decode_r21.json", 1000.0, "tpu",
+                    kv_dtype="bf16", spec=0),
+        _traj_entry(tmp_path, "BENCH_decode_r22.json", 600.0, "tpu",
+                    kv_dtype="int8", spec=0),
+        _traj_entry(tmp_path, "BENCH_decode_r23.json", 400.0, "tpu",
+                    kv_dtype="int8", spec=4),
+    ]
+    assert bs.check_trajectory(mixed) == []
+    # a second round regressing ONLY on the (int8, spec=4) leg fails,
+    # anchored to the last entry of THAT leg — not to the bf16 line
+    # that sits between them
+    mixed += [
+        _traj_entry(tmp_path, "BENCH_decode_r24.json", 1010.0, "tpu",
+                    kv_dtype="bf16", spec=0),
+        _traj_entry(tmp_path, "BENCH_decode_r25.json", 300.0, "tpu",
+                    kv_dtype="int8", spec=4),
+    ]
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "regression" in fails[0]
+    assert "BENCH_decode_r25" in fails[0] and "BENCH_decode_r23" in fails[0]
+    # legacy lines (no kv_dtype/spec fields) key their own cursor and
+    # never compare against the new matrix legs
+    legacy = [
+        _traj_entry(tmp_path, "BENCH_decode_r31.json", 900.0, "tpu"),
+        _traj_entry(tmp_path, "BENCH_decode_r32.json", 500.0, "tpu",
+                    kv_dtype="int8", spec=0),
+        _traj_entry(tmp_path, "BENCH_decode_r33.json", 895.0, "tpu"),
+    ]
+    assert bs.check_trajectory(legacy) == []
 
 
 def test_trajectory_mode_accepts_committed_repo_files():

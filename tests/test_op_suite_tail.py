@@ -44,6 +44,28 @@ def fmat_c(*shape):
     return fmat(*shape)
 
 
+def _scatter_nd_ref(idx, upd, shape):
+    """scatter_nd sums duplicate-index updates into zeros (np.add.at)."""
+    out = np.zeros(shape, np.asarray(upd).dtype)
+    np.add.at(out, tuple(np.asarray(idx, np.int64).T), upd)
+    return out
+
+
+def _scatter_nd_add_ref(x, idx, upd):
+    out = np.array(x)
+    np.add.at(out, tuple(np.asarray(idx, np.int64).T), upd)
+    return out
+
+
+def _masked_scatter_ref(x, mask, src):
+    """Row-major fill of the masked positions from the flattened source
+    (torch masked_scatter semantics — matches the fixed-value test)."""
+    out = np.array(x)
+    m = np.asarray(mask, bool)
+    out[m] = np.asarray(src).reshape(-1)[:int(m.sum())]
+    return out
+
+
 TAIL_SPECS = [
     Spec("as_complex", fmat_c(4, 3, 2),   # reference: last dim == 2 pairs
          lambda x: np.abs(x[..., 0] + 1j * x[..., 1]),
@@ -99,21 +121,27 @@ TAIL_SPECS = [
          lambda x, i, v, axis: np.put_along_axis(x.copy(), i, v, axis)
          or np.put_along_axis((y := x.copy()), i, v, axis) or y,
          fn="put_along_axis", bf16=False),
+    # live numpy refs (ISSUE 8 skip audit: these three used to carry
+    # ref=None and skip the forward-parity param with "checked via
+    # dedicated test below" — duplicate-index/ordering semantics are
+    # expressible with np.add.at / boolean assignment, so they parity-
+    # check like everything else; the dedicated value tests below stay
+    # as fixed-value cross-checks)
     Spec("scatter_nd",
          lambda: ([RNG.randint(0, 6, (3, 1)).astype(np.int64),
                    RNG.uniform(-1, 1, (3, 4)).astype(np.float32)],
                   {"shape": [6, 4]}),
-         None, bf16=False),
+         _scatter_nd_ref, bf16=False),
     Spec("scatter_nd_add",
          lambda: ([RNG.uniform(-1, 1, (6, 4)).astype(np.float32),
                    np.asarray([[1], [3], [1]], np.int64),
                    RNG.uniform(-1, 1, (3, 4)).astype(np.float32)], {}),
-         None, bf16=False, grad=(0, 2)),
+         _scatter_nd_add_ref, bf16=False, grad=(0, 2)),
     Spec("masked_scatter",
          lambda: ([RNG.uniform(-1, 1, (4, 4)).astype(np.float32),
                    (RNG.uniform(size=(4, 4)) < 0.4),
                    RNG.uniform(-1, 1, (16,)).astype(np.float32)], {}),
-         None, bf16=False),
+         _masked_scatter_ref, bf16=False),
     Spec("fill_diagonal", with_kw(fmat(5, 5), value=7.0),
          lambda x, value: _np_fill_diag(x, value), bf16=False),
     Spec("broadcast_tensors",
@@ -202,8 +230,9 @@ TAIL_SPECS += [
 
 @pytest.mark.parametrize("spec", TAIL_SPECS, ids=lambda s: s.name)
 def test_tail_forward_parity_f32(spec):
-    if spec.ref is None:
-        pytest.skip("checked via dedicated test below")
+    # every spec carries a live numpy ref (the last three ref=None
+    # skips were converted in the ISSUE-8 skip audit)
+    assert spec.ref is not None
     _check_parity(spec, np.float32)
 
 

@@ -38,10 +38,12 @@ gates run over each series —
   the CPU CI run is exactly as able to catch a retrace as a chip run —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
-  whose ``config.backend == "tpu"`` (same model), a >3% drop in
-  ``value`` fails.  CPU entries never perf-gate (smoke numbers), so the
-  gate arms itself automatically the first session that records chip
-  numbers.
+  whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
+  kv_dtype, spec)`` cursor key matches (the ISSUE-8 A/B matrix
+  interleaves quantized/speculative lines in one trajectory), a >3%
+  drop in ``value`` fails.  CPU entries never perf-gate (smoke
+  numbers), so the gate arms itself automatically the first session
+  that records chip numbers.
 
 ``--trajectory --write OUT`` additionally emits the assembled series as
 one JSON document (the trajectory file CI archives).
@@ -183,12 +185,17 @@ def _extract_line(doc: Any, path: str) -> Any:
     return doc
 
 
-# the compile-once contract per metric series: which watchdog entry (or
-# legacy top-level compile_counts key) must be exactly 1 whenever the
-# line reports compile accounting at all
+# the compile-once contract per metric series: which watchdog entries (or
+# legacy top-level compile_counts keys) must be exactly 1 whenever the
+# line reports them at all.  A speculative line carries
+# serving.spec_verify instead of serving.decode (the single-token
+# fallback never ran, and a zero count is omitted by contract), so each
+# key gates only when present.
 _COMPILE_ONCE = {
     "decode_tokens_per_sec": (("metrics", "serving.decode"),
-                              ("top", "decode")),
+                              ("metrics", "serving.spec_verify"),
+                              ("top", "decode"),
+                              ("top", "verify")),
 }
 
 REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
@@ -217,6 +224,10 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "backend": cfg.get("backend"),
             "model": cfg.get("model"),
             "cache_layout": line.get("cache_layout"),
+            # ISSUE-8 A/B axes: absent on pre-quant/spec lines — None
+            # then keys its own legacy cursor, so old series stay gated
+            "kv_dtype": line.get("kv_dtype"),
+            "spec": line.get("spec"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
                 "compile_counts", line.get("compile_counts")),
         }
@@ -236,17 +247,19 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                                                       cc[key]))
 
     # gate 2 — on-chip regression between consecutive chip entries.
-    # One cursor per (model, cache_layout) within each metric: a series
-    # that interleaves layouts (bench_decode --both emits paged AND
-    # slotted lines per round) still compares like-for-like — a single
-    # cursor would skip every comparison AND lose its anchor, leaving
-    # the gate silently inert.
+    # One cursor per (model, cache_layout, kv_dtype, spec) within each
+    # metric: a series that interleaves layouts (bench_decode --both) or
+    # the ISSUE-8 quant/speculation axes (--kv-dtype bf16,int8 --spec
+    # off,4 emits a matrix per round) still compares like-for-like — a
+    # single cursor would skip every mismatched pair AND lose its
+    # anchor, leaving the gate silently inert (regression-tested).
     for metric, entries in series.items():
         prev_by_key = {}
         for e in entries:
             if e["backend"] != "tpu":
                 continue
-            key = (e.get("model"), e.get("cache_layout"))
+            key = (e.get("model"), e.get("cache_layout"),
+                   e.get("kv_dtype"), e.get("spec"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
