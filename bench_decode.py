@@ -61,11 +61,13 @@ import time
 import numpy as np
 
 
-def run_config(paged: bool, kv_dtype: str, spec: int):
+def run_config(paged: bool, kv_dtype: str, spec: int,
+               trace_file: str = None):
     import jax
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import tracing as _tracing
     from paddle_tpu.serving.engine import DecodeEngine
     from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                               Request)
@@ -98,18 +100,22 @@ def run_config(paged: bool, kv_dtype: str, spec: int):
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     model.eval()
 
+    # --trace-file (ISSUE 9): a live tracer threaded through engine AND
+    # scheduler; with a multi-config matrix the file holds the LAST
+    # configuration's trace (each run_config overwrites it)
+    tracer = _tracing.Tracer() if trace_file else None
     engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
                           seed=0, paged=paged, page_size=page_size,
                           kv_dtype=("int8" if kv_dtype == "int8"
                                     else None),
-                          spec_k=spec)
+                          spec_k=spec, tracer=tracer)
     rng = np.random.default_rng(0)
     # one shared "system prompt" a third of the requests reuse — the
     # prefix-sharing path must be ON the timed path, not a dead feature
     shared_prompt = rng.integers(0, cfg.vocab_size, (prompt_len,))
 
     def drive(n_requests):
-        sched = ContinuousBatchingScheduler(engine)
+        sched = ContinuousBatchingScheduler(engine, tracer=tracer)
         for i in range(n_requests):
             prompt = (shared_prompt if paged and i % 3 == 0
                       else rng.integers(0, cfg.vocab_size, (prompt_len,)))
@@ -133,13 +139,20 @@ def run_config(paged: bool, kv_dtype: str, spec: int):
     drive(min(num_slots, requests))
     engine.reset()      # pages/slots back + kv/spec stats re-zeroed
     # percentiles must describe the TIMED drain, not the compile-heavy
-    # warmup — drop warmup samples.  reset() also zeroes the registry's
-    # compile.count shadow of the watchdog (whose ground truth, the jit
-    # cache sizes, survives) — resync so exports stay in agreement.
+    # warmup — drop warmup samples.  ORDERING (OBSERVABILITY.md): the
+    # flight recorder snapshots the CUMULATIVE metrics first — reset()
+    # zeroes exactly the counters (warmup compiles, faultpoint fires) a
+    # post-mortem dump would want cumulative; then reset; then resync
+    # the compile.count shadow of the watchdog (whose ground truth, the
+    # jit cache sizes, survives the reset).
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import flight as _flight
     from paddle_tpu.observability import watchdog as _wd
+    _flight.note_registry_reset()
     obs.default_registry().reset()
     _wd.resync_counter()
+    if tracer is not None:
+        tracer.reset()  # the exported trace describes the timed drain
 
     results, dt = drive(requests)
     total_tokens = sum(r.tokens.size for r in results.values())
@@ -217,6 +230,20 @@ def run_config(paged: bool, kv_dtype: str, spec: int):
             st["accepted"] / max(st["steps"], 1), 3)
         result["spec_accept_rate"] = round(
             st["accepted"] / max(st["proposed"], 1), 4)
+    if tracer is not None:
+        tracer.export_jsonl(trace_file)
+        counts = tracer.span_counts()
+        # per-request span counts, keyed by rid via the trace_id each
+        # RequestResult now carries (lane 0 is the shared engine lane)
+        result["trace"] = {
+            "file": trace_file,
+            "spans": int(sum(counts.values())),
+            "engine_spans": int(counts.get(0, 0)),
+            "requests": len(results),
+            "per_request_spans": {
+                str(r.rid): int(counts.get(r.trace_id, 0))
+                for r in results.values()},
+        }
     print(json.dumps(result))
     sys.stdout.flush()
 
@@ -242,6 +269,12 @@ def main(argv=None):
     ap.add_argument("--spec", default="off",
                     help="comma list of off|<k>: speculative draft "
                          "length per iteration (paged only)")
+    ap.add_argument("--trace-file", default=None, metavar="PATH",
+                    help="export a request-scoped span trace (JSONL) of "
+                         "the timed drain; feed it to `python -m "
+                         "paddle_tpu.observability trace-report`.  With "
+                         "a multi-config matrix the file holds the last "
+                         "configuration's trace")
     args = ap.parse_args(argv)
 
     layouts = ([True, False] if args.both
@@ -277,7 +310,7 @@ def main(argv=None):
     for paged, kv_dtype, spec in configs:
         # run_config resets the registry and resyncs the watchdog after
         # its own warmup drain, so no inter-config state scrub is needed
-        run_config(paged, kv_dtype, spec)
+        run_config(paged, kv_dtype, spec, trace_file=args.trace_file)
 
 
 if __name__ == "__main__":

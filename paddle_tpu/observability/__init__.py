@@ -17,8 +17,19 @@ subsystem:
   warns on budget violations, raises under ``PADDLE_TPU_STRICT_COMPILE=1``.
 * :mod:`.exporters` — Prometheus text, JSONL snapshots, chrome-trace
   metric marks injected into the :mod:`paddle_tpu.profiler` stream.
-* CLI: ``python -m paddle_tpu.observability dump|serve|tail`` over the
-  JSONL snapshot stream (``PADDLE_TPU_METRICS_FILE``).
+* :mod:`.tracing` — request-scoped span tracing (ISSUE 9): a trace_id
+  per serving request, spans with parent links over queue/prefill-chunk/
+  decode/verify/preemption phases, chrome-trace + JSONL export, and the
+  ``trace-report`` timeline/attribution analyzer.  Disabled by default
+  (``PADDLE_TPU_TRACING=1`` arms it — no-op identity tracer otherwise).
+* :mod:`.flight` — the black-box flight recorder: a bounded ring of
+  recent span/engine events plus metrics + engine-state snapshots,
+  dumped to a file on DivergenceError / strict RecompileError /
+  preemption-guard fires / faultpoint-raised crashes
+  (``PADDLE_TPU_FLIGHT=1`` arms it).
+* CLI: ``python -m paddle_tpu.observability dump|serve|tail|trace-report``
+  over the JSONL snapshot stream (``PADDLE_TPU_METRICS_FILE``) and span
+  trace files.
 
 Import discipline: this package must stay importable before (and without)
 jax — the registry is pure stdlib; jax-adjacent pieces (profiler marks)
@@ -26,10 +37,12 @@ import lazily.  See OBSERVABILITY.md for the metric catalog and knobs.
 """
 from __future__ import annotations
 
+from . import flight
 from .catalog import CATALOG
 from .registry import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
                        Gauge, Histogram, Registry, counter, default_registry,
                        flush, gauge, histogram)
+from .tracing import NOOP_SPAN, NOOP_TRACER, Tracer, default_tracer
 from .watchdog import (RecompileError, RecompileWarning, WatchedEntry,
                        compile_counts, watch)
 
@@ -39,4 +52,5 @@ __all__ = [
     "counter", "gauge", "histogram", "default_registry", "flush",
     "RecompileError", "RecompileWarning", "WatchedEntry", "watch",
     "compile_counts",
+    "Tracer", "NOOP_TRACER", "NOOP_SPAN", "default_tracer", "flight",
 ]

@@ -1,8 +1,19 @@
-"""CLI over the JSONL metric-snapshot stream.
+"""CLI over the JSONL metric-snapshot stream and span-trace files.
 
     python -m paddle_tpu.observability dump  [--file P] [--format prom|json]
     python -m paddle_tpu.observability tail  [--file P] [--follow] [--interval S]
     python -m paddle_tpu.observability serve [--file P] [--port N]
+    python -m paddle_tpu.observability trace-report --file T \\
+        [--format table|json] [--chrome OUT] [--allow-empty]
+
+``trace-report`` (ISSUE 9) reconstructs per-request timelines from a
+span trace (the JSONL a :class:`~.tracing.Tracer` exports — see
+``bench_decode.py --trace-file``) and prints TTFT/TPOT attribution
+(queue vs prefill vs decode vs preemption-rework share) per request;
+``--chrome OUT`` additionally writes the chrome://tracing JSON with one
+lane per request.  Exit 2 when the file holds no request traces (unless
+``--allow-empty``), exit 1 when any request's span tree is
+disconnected — CI uses both as hard gates.
 
 ``--file`` defaults to ``$PADDLE_TPU_METRICS_FILE``.  ``dump`` renders the
 newest snapshot (Prometheus text by default); with no file configured it
@@ -145,6 +156,39 @@ def make_server(path, port=0, in_process=False):
     return HTTPServer(("127.0.0.1", port), Handler)
 
 
+def cmd_trace_report(args) -> int:
+    from . import tracing
+    if not args.file:
+        print("trace-report needs --file (a Tracer JSONL export) or "
+              "PADDLE_TPU_TRACE_FILE", file=sys.stderr)
+        return 2
+    try:
+        spans, events, _metas = tracing.load_trace(args.file)
+    except FileNotFoundError:
+        print("no trace at %s" % args.file, file=sys.stderr)
+        return 2
+    report = tracing.build_report(spans, events)
+    if args.chrome:
+        tracing.write_chrome(args.chrome, spans, events,
+                             include_profiler=False)
+        print("chrome trace written to %s" % args.chrome,
+              file=sys.stderr)
+    if not report["requests"] and not args.allow_empty:
+        print("no request traces in %s (0 spans with a 'request' root)"
+              % args.file, file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(tracing.format_report(report))
+    if not report["totals"]["connected"]:
+        print("trace-report: DISCONNECTED span tree(s) — a span's "
+              "parent link does not reach its request root",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_serve(args) -> int:
     srv = make_server(args.file, args.port)
     print("serving /metrics on http://127.0.0.1:%d (source: %s)"
@@ -176,6 +220,21 @@ def main(argv=None) -> int:
     s.add_argument("--file", default=default_file)
     s.add_argument("--port", type=int, default=9464)
     s.set_defaults(fn=cmd_serve)
+
+    r = sub.add_parser("trace-report",
+                       help="per-request timeline + TTFT/TPOT "
+                            "attribution from a span trace file")
+    r.add_argument("--file",
+                   default=os.environ.get("PADDLE_TPU_TRACE_FILE"))
+    r.add_argument("--format", choices=("table", "json"),
+                   default="table")
+    r.add_argument("--chrome", default=None, metavar="OUT",
+                   help="also write chrome://tracing JSON (one lane per "
+                        "request) to OUT")
+    r.add_argument("--allow-empty", action="store_true",
+                   help="exit 0 even when the file holds no request "
+                        "traces")
+    r.set_defaults(fn=cmd_trace_report)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,236 @@
+"""The black-box flight recorder: a bounded ring of recent span/engine
+events plus a state snapshot, dumped to a timestamped file when the
+process hits a terminal fault.
+
+Aggregate metrics say *that* a run died; the flight recorder says what
+the last N things it did were.  While active it keeps:
+
+* a **fixed-size ring** of recent events — finished tracing spans (fed
+  by :mod:`.tracing` when both are enabled), recompile-watchdog growth,
+  faultpoint fires, divergence rollbacks, preemption notices — cheap
+  host-side dict appends, drop-oldest;
+* a registry of live :class:`~paddle_tpu.serving.engine.DecodeEngine`\\ s
+  (weakrefs — recording never pins an engine) whose state summary (slot
+  table, page-pool occupancy, compile counts) is collected at dump time;
+* optionally, the **pre-reset cumulative metrics snapshot**: benches
+  call ``Registry.reset()`` after warmup, which would zero exactly the
+  counters a post-mortem wants cumulative — ``note_registry_reset()``
+  (called by bench_decode.py immediately BEFORE the reset) preserves
+  them as ``metrics_pre_reset`` in every later dump.
+
+Dump triggers (wired through the PR-4 robustness hooks, so the chaos
+suite can assert dump contents):
+
+* a faultpoint action that raises (``robustness.faultpoints``),
+* a strict-mode :class:`~.watchdog.RecompileError`,
+* :class:`~paddle_tpu.robustness.sentinel.DivergenceError` (snapshot
+  ring exhausted),
+* a preemption-guard fire (``robustness.preemption``).
+
+Each dump is one JSON file ``flight-<stamp>-<pid>-<seq>.json`` in
+``PADDLE_TPU_FLIGHT_DIR`` (default: cwd) holding the trigger, the ring,
+the current metrics snapshot (catalog-valid by construction — it is the
+default registry's own), the pre-reset snapshot when noted, watchdog
+compile counts, and every live engine's state summary.
+
+Disabled by default (``PADDLE_TPU_FLIGHT=0`` — registry discipline):
+``record()`` is one module-global ``None`` check and dump triggers
+no-op, so chaos tests and production opt in via the env var or
+:func:`enable`.  Dumping never raises: a broken flight dump must not
+mask the fault that triggered it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import registry as _registry
+
+__all__ = [
+    "FlightRecorder", "enable", "disable", "active", "record",
+    "register_engine", "note_registry_reset", "crash_dump",
+    "last_dump_path", "RING_DEFAULT",
+]
+
+#: default ring capacity (events); override with PADDLE_TPU_FLIGHT_RING
+RING_DEFAULT = 256
+
+#: live engines whose state summaries land in dumps; module-level (not
+#: per-recorder) so engines built before enable() are still covered
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+_ACTIVE: Optional["FlightRecorder"] = None
+_LOCK = threading.Lock()
+_SEQ = 0
+
+
+class FlightRecorder:
+    def __init__(self, dir: Optional[str] = None,
+                 capacity: Optional[int] = None):
+        self.dir = dir or os.environ.get("PADDLE_TPU_FLIGHT_DIR") or "."
+        cap = capacity if capacity is not None else int(os.environ.get(
+            "PADDLE_TPU_FLIGHT_RING", RING_DEFAULT))
+        self.ring: deque = deque(maxlen=max(int(cap), 1))
+        # reentrant: dump() records the trigger then re-reads the ring,
+        # and crash paths can re-enter record() from under a dump
+        self._lock = threading.RLock()
+        self._pre_reset_metrics: Optional[dict] = None
+        self.dumps: List[str] = []
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, kind: str, **fields):
+        ev = {"kind": str(kind), "wall_ts": time.time(),
+              "perf_ns": time.perf_counter_ns()}
+        ev.update(fields)
+        with self._lock:
+            self.ring.append(ev)
+        return ev
+
+    def note_registry_reset(self, snapshot: Optional[dict] = None):
+        """Preserve the cumulative metrics view a ``Registry.reset()`` is
+        about to zero (call IMMEDIATELY BEFORE the reset — the ordering
+        contract OBSERVABILITY.md documents)."""
+        self._pre_reset_metrics = (snapshot if snapshot is not None
+                                   else _registry.default_registry()
+                                   .snapshot())
+        self.record("registry_reset")
+
+    # -- dumping -----------------------------------------------------------
+
+    def _engine_states(self) -> List[dict]:
+        out = []
+        for e in list(_ENGINES):
+            try:
+                out.append(e.flight_state())
+            except Exception as exc:    # a torn engine must not kill dumps
+                out.append({"error": repr(exc)})
+        return out
+
+    def dump(self, trigger: Dict[str, Any],
+             path: Optional[str] = None) -> str:
+        """Write one flight-dump file; returns its path.  The trigger is
+        recorded and the ring copied in ONE critical section, so the
+        triggering event is always the dump's newest ring entry — a
+        concurrent thread's record() can neither displace nor evict it."""
+        try:
+            metrics = _registry.default_registry().snapshot()
+        except Exception:
+            metrics = {}
+        try:
+            from .watchdog import compile_counts
+            compiles = compile_counts()
+        except Exception:
+            compiles = {}
+        with self._lock:    # RLock: record() below re-enters it
+            self.record("trigger", detail=dict(trigger))
+            ring = list(self.ring)
+            pre = self._pre_reset_metrics
+        doc = {
+            "format": "paddle_tpu-flight-v1",
+            "wall_ts": time.time(),
+            "perf_ns": time.perf_counter_ns(),
+            "pid": os.getpid(),
+            "trigger": dict(trigger),
+            "ring": ring,
+            "ring_capacity": self.ring.maxlen,
+            "metrics": metrics,
+            "metrics_pre_reset": pre,
+            "compile_counts": compiles,
+            "engines": self._engine_states(),
+        }
+        if path is None:
+            global _SEQ
+            with _LOCK:
+                _SEQ += 1
+                seq = _SEQ
+            os.makedirs(self.dir, exist_ok=True)
+            path = os.path.join(
+                self.dir, "flight-%s-%d-%d.json"
+                % (time.strftime("%Y%m%dT%H%M%S"), os.getpid(), seq))
+        with open(path, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.write("\n")
+        self.dumps.append(path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# module-level API (what the instrumented subsystems call)
+# ---------------------------------------------------------------------------
+
+def enable(dir: Optional[str] = None,
+           capacity: Optional[int] = None) -> FlightRecorder:
+    """Install (or replace) the process-wide recorder."""
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = FlightRecorder(dir=dir, capacity=capacity)
+        return _ACTIVE
+
+
+def disable():
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[FlightRecorder]:
+    return _ACTIVE
+
+
+def record(kind: str, **fields):
+    """Ring append when a recorder is active; one global ``None`` check
+    otherwise (cheap enough for the instrumented fault paths)."""
+    r = _ACTIVE
+    if r is None:
+        return None
+    return r.record(kind, **fields)
+
+
+def register_engine(engine):
+    """Track a serving engine (weakref) for dump-time state summaries.
+    Always cheap; engines register unconditionally at construction."""
+    _ENGINES.add(engine)
+
+
+def note_registry_reset(snapshot: Optional[dict] = None):
+    r = _ACTIVE
+    if r is None:
+        return None
+    return r.note_registry_reset(snapshot)
+
+
+def crash_dump(trigger: Dict[str, Any]) -> Optional[str]:
+    """Dump on a terminal fault; never raises (a failed dump must not
+    mask the fault being reported).  Returns the path or None."""
+    r = _ACTIVE
+    if r is None:
+        return None
+    try:
+        path = r.dump(trigger)
+        sys.stderr.write("[flight] dumped %s (trigger: %s)\n"
+                         % (path, trigger.get("kind")))
+        return path
+    except Exception as e:
+        sys.stderr.write("[flight] dump FAILED: %r\n" % (e,))
+        return None
+
+
+def last_dump_path() -> Optional[str]:
+    r = _ACTIVE
+    if r is None or not r.dumps:
+        return None
+    return r.dumps[-1]
+
+
+# env opt-in: PADDLE_TPU_FLIGHT=1 arms the recorder at import time (the
+# registry's env-knob discipline; PADDLE_TPU_FLIGHT_DIR/_RING configure it)
+if os.environ.get("PADDLE_TPU_FLIGHT", "0") not in ("0", "", "false",
+                                                    "off"):
+    enable()

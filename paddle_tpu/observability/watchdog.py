@@ -124,11 +124,20 @@ class WatchedEntry:
                 return
             self._seen = n
         self._counter.labels(entry=self._name).inc(grew)
+        from . import flight as _flight
+        _flight.record("recompile", entry=self._name, compile_count=n,
+                       expected=self._expected)
         if self._expected is not None and n > self._expected:
             payload = json.dumps({
                 "event": "recompile", "entry": self._name,
                 "compile_count": n, "expected": self._expected}, sort_keys=True)
             if strict_mode():
+                # black-box dump BEFORE the raise: the strict error is
+                # fatal by design, so this is the post-mortem's one shot
+                # at the ring + engine state (no-op unless armed)
+                _flight.crash_dump({
+                    "kind": "recompile", "entry": self._name,
+                    "compile_count": n, "expected": self._expected})
                 raise RecompileError(
                     "compile-once violation: %s — the jit entry %r now "
                     "holds %d programs (budget %d); an argument "

@@ -303,11 +303,23 @@ class FaultPlan:
         # fire OUTSIDE the lock: an action may block, exit, or re-enter
         # another faultpoint via the recovery path it triggers
         if due:
+            from ..observability import flight as _flight
             from ..observability import registry as _metrics
             _metrics.counter("robustness.faultpoint_fires",
                              ("site",)).labels(site=site).inc(len(due))
+            for r in due:
+                _flight.record("faultpoint", site=site, index=index,
+                               action=repr(r.action))
         for r in due:
-            r.action.fire(ctx, self)
+            try:
+                r.action.fire(ctx, self)
+            except BaseException as e:
+                # a faultpoint-raised crash is a flight-dump trigger: the
+                # ring already holds the firing event recorded above
+                _flight.crash_dump({
+                    "kind": "faultpoint", "site": site, "index": index,
+                    "action": repr(r.action), "error": repr(e)})
+                raise
         return ctx
 
     # -- assertions --------------------------------------------------------

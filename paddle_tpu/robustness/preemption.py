@@ -70,6 +70,8 @@ class PreemptionGuard:
 
     def __init__(self, signals=None, install: bool = True):
         self._flag = threading.Event()
+        self._pending_flight: Optional[str] = None  # deferred dump source
+        self._pending_lock = threading.Lock()       # exactly-once claim
         self.signals = list(signals) if signals is not None \
             else _signals_from_env()
         self._old = {}
@@ -97,12 +99,36 @@ class PreemptionGuard:
             self._installed = False
 
     def _on_signal(self, signum, frame):
+        # signal-handler frame: flip the flag and DEFER the flight dump.
+        # The handler interrupts the main thread mid-bytecode — it may
+        # be inside the flight ring's / a metric's non-reentrant lock,
+        # and a synchronous dump here could deadlock (or do heavy IO at
+        # the worst moment).  The dump fires at the first `preempted`
+        # poll, which is exactly the drain boundary this guard exists
+        # to reach.
         self._flag.set()
+        self._pending_flight = "signal:%s" % signal.Signals(signum).name
         sys.stderr.write(
             "[preemption] received %s — draining at the next step/epoch "
             "boundary (rc=%d)\n"
             % (signal.Signals(signum).name, PREEMPTED_RC))
         sys.stderr.flush()
+
+    def _fire(self, source: str):
+        """Flip the flag; the FIRST fire per armed window also triggers a
+        flight-recorder dump (the black box's 'we are being evicted'
+        snapshot — no-op unless the recorder is armed).  Only called
+        from normal (non-signal) frames: `set()`/chaos `simulate()`, or
+        the deferred-signal path in :meth:`preempted`."""
+        first = not self._flag.is_set()
+        self._flag.set()
+        if first:
+            self._dump_flight(source)
+
+    def _dump_flight(self, source: str):
+        from ..observability import flight as _flight
+        _flight.record("preemption", source=source)
+        _flight.crash_dump({"kind": "preemption", "source": source})
 
     def __enter__(self):
         return self.install()
@@ -114,14 +140,25 @@ class PreemptionGuard:
     # -- flag ---------------------------------------------------------------
     @property
     def preempted(self) -> bool:
-        return self._flag.is_set()
+        p = self._flag.is_set()
+        if p and self._pending_flight is not None:
+            # first safe-context poll after a real signal: emit the
+            # deferred flight dump here (normal frame, no interrupted
+            # locks beneath us).  The claim is locked so two concurrent
+            # pollers produce exactly one dump.
+            with self._pending_lock:
+                src, self._pending_flight = self._pending_flight, None
+            if src is not None:
+                self._dump_flight(src)
+        return p
 
     def set(self):
         """Flip the flag programmatically (chaos / external schedulers)."""
-        self._flag.set()
+        self._fire("set")
 
     def clear(self):
         self._flag.clear()
+        self._pending_flight = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._flag.wait(timeout)

@@ -138,6 +138,10 @@ class DivergenceSentinel:
         from ..core import set_rng_state
 
         if not self._ring:
+            from ..observability import flight as _flight
+            _flight.crash_dump({
+                "kind": "divergence", "step": bad_step,
+                "loss": repr(bad_loss), "rewinds": len(self.rewinds)})
             raise DivergenceError(
                 "loss diverged at step %s (loss=%r) and the snapshot ring "
                 "is exhausted — no known-good state to rewind to; restore "
@@ -158,8 +162,11 @@ class DivergenceSentinel:
         self._skip_streak = 0
         self.rewinds.append((int(bad_step) if bad_step is not None else -1,
                              snap["step"], bad_loss))
+        from ..observability import flight as _flight
         from ..observability import registry as _metrics
         _metrics.counter("train.divergence_rollbacks").inc()
+        _flight.record("divergence_rollback", bad_step=bad_step,
+                       to_step=snap["step"], loss=repr(bad_loss))
         warnings.warn(
             "divergence at step %s (loss=%r): rewound training state to "
             "step %d (%d snapshot(s) left)"

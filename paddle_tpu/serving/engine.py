@@ -81,6 +81,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import time
 
 import numpy as np
 
@@ -89,7 +90,9 @@ import jax.numpy as jnp
 
 from ..core.dtype import x64_scope
 from ..core.tensor import Tensor
+from ..observability import flight as _flight
 from ..observability import registry as _metrics
+from ..observability import tracing as _tracing
 from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
                     PagedPrefillChunkView, PrefillView, SlottedKVCache,
                     _unwrap)
@@ -155,9 +158,14 @@ class DecodeEngine:
                  min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True,
                  paged=True, page_size=64, num_pages=None,
                  prefill_chunk=None, kv_dtype=None, spec_k=0,
-                 spec_ngram=3):
+                 spec_ngram=3, tracer=None):
         cfg = model.config
         self.model = model
+        # request-scoped tracing (ISSUE 9): the engine lane carries one
+        # dispatch span per compiled-entry call with the watchdog's
+        # compile-count delta; the no-op default costs one bool check
+        self._tracer = (tracer if tracer is not None
+                        else _tracing.default_tracer())
         self.num_slots = int(num_slots)
         self.max_len = int(max_len or cfg.max_position_embeddings)
         if self.max_len > cfg.max_position_embeddings:
@@ -226,6 +234,9 @@ class DecodeEngine:
                              donate)
         else:
             self._init_slotted(cfg, min_bucket, donate)
+        # black-box flight recorder: dumps collect this engine's state
+        # summary (weakref — registration never pins the engine)
+        _flight.register_engine(self)
 
     def _kv_dtype_arg(self):
         return "int8" if self._quantized else None
@@ -329,7 +340,8 @@ class DecodeEngine:
                                  else min(64, self.max_len))
         self.prompt_cap = self.max_len
         self._alloc = PageAllocator(self.num_pages, self.num_slots,
-                                    self.max_pages, self.page_size)
+                                    self.max_pages, self.page_size,
+                                    tracer=self._tracer)
         self._len_host = np.zeros((self.num_slots,), np.int64)
         self.cache = PagedKVCache.create(
             self.num_pages, self._layers, self.page_size, self._heads,
@@ -545,6 +557,15 @@ class DecodeEngine:
             # train.grad_norm gauge)
             self._m_qerr.set(float(np.asarray(qerr)))
 
+    def _dispatch_span(self, name, entry, t0_ns, c0):
+        """Engine-lane span for one compiled-entry dispatch, carrying the
+        watchdog's compile-count delta: a nonzero ``compiles`` attr on a
+        steady-state step IS the silent-retrace bug class, now visible
+        at the exact call in the trace timeline."""
+        c1 = int(entry.compile_count)
+        self._tracer.add_span(name, t0_ns, time.perf_counter_ns(),
+                              compile_count=c1, compiles=c1 - c0)
+
     # -- paged page bookkeeping (host side) --------------------------------
 
     def _set_length(self, slot, n):
@@ -583,10 +604,16 @@ class DecodeEngine:
         new_pid = self._alloc.alloc()
         old_pid = int(self._alloc.table[int(slot), int(idx)])
         c = self.cache
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._cow.compile_count
+            t0_ns = time.perf_counter_ns()
         with x64_scope(False):
             k, v, ks, vs = self._cow(c.k, c.v, c.k_scale, c.v_scale,
                                      jnp.asarray(old_pid, jnp.int32),
                                      jnp.asarray(new_pid, jnp.int32))
+        if tr_on:
+            self._dispatch_span("engine.cow_copy", self._cow, t0_ns, c0)
         self._alloc.remap(int(slot), int(idx), new_pid)
         self.cache = PagedKVCache(k, v, c.page_table, c.lengths,
                                   k_scale=ks, v_scale=vs)
@@ -688,6 +715,10 @@ class DecodeEngine:
         final = task.pos + n_valid >= n
         key = (self._next_key() if final
                else jax.random.fold_in(self._base_key, 0))
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._prefill_chunk.compile_count
+            t0_ns = time.perf_counter_ns()
         # x64_scope(False) covers the (first-call) TRACE: the serving
         # programs carry no s64/f64 — jax.random's counters and gather
         # index widening follow the global x64 default otherwise (same
@@ -704,6 +735,9 @@ class DecodeEngine:
                 jnp.asarray(task.temperature, jnp.float32),
                 jnp.asarray(min(task.top_k, self.top_k_max), jnp.int32),
                 jnp.asarray(task.top_p, jnp.float32))
+        if tr_on:
+            self._dispatch_span("engine.prefill_chunk",
+                                self._prefill_chunk, t0_ns, c0)
         self.cache = PagedKVCache(k, v, self._alloc.device_table(),
                                   lengths, k_scale=ks, v_scale=vs)
         task.pos += n_valid
@@ -742,6 +776,10 @@ class DecodeEngine:
         bucket = self.bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = ids
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._prefill.compile_count
+            t0_ns = time.perf_counter_ns()
         # x64/eval scopes: see prefill_step()
         with x64_scope(False), _eval_scope(self.model):
             tok, logits, k, v, ks, vs, lengths = self._prefill(
@@ -753,6 +791,8 @@ class DecodeEngine:
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(min(int(top_k), self.top_k_max), jnp.int32),
                 jnp.asarray(top_p, jnp.float32))
+        if tr_on:
+            self._dispatch_span("engine.prefill", self._prefill, t0_ns, c0)
         self.cache = SlottedKVCache(k, v, lengths, k_scale=ks, v_scale=vs)
         return int(tok), logits
 
@@ -775,6 +815,10 @@ class DecodeEngine:
                 raise PagePoolExhausted(
                     "no free page for slot %d's append — evict a slot "
                     "(the scheduler does this refcount-aware)" % blocked)
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._decode.compile_count
+            t0_ns = time.perf_counter_ns()
         # x64/eval scopes: see prefill_step() — keep the traced program
         # s64/f64-free and the caller's train/eval mode untouched
         with x64_scope(False), _eval_scope(self.model):
@@ -809,6 +853,8 @@ class DecodeEngine:
                 # the slotted read bound IS the flat slots*max_len sweep
                 self.cache = SlottedKVCache(k, v, lengths,
                                             k_scale=ks, v_scale=vs)
+        if tr_on:
+            self._dispatch_span("engine.decode", self._decode, t0_ns, c0)
         self._set_quant_err(qerr)
         return np.asarray(tok), logits
 
@@ -841,6 +887,10 @@ class DecodeEngine:
                     "evict a slot (the scheduler does this "
                     "refcount-aware)" % blocked)
         step_toks = np.concatenate([toks, drafts_np], axis=1)  # (S, k+1)
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._verify.compile_count
+            t0_ns = time.perf_counter_ns()
         with x64_scope(False), _eval_scope(self.model):
             emitted, counts, logits, kk, v, ks, vs, lengths, qerr = \
                 self._verify(
@@ -855,6 +905,9 @@ class DecodeEngine:
                     jnp.asarray(np.asarray(top_p, np.float32)))
             self.cache = PagedKVCache(kk, v, self._alloc.device_table(),
                                       lengths, k_scale=ks, v_scale=vs)
+        if tr_on:
+            self._dispatch_span("engine.spec_verify", self._verify,
+                                t0_ns, c0)
         counts_np = np.asarray(counts, np.int64)
         # mirror the program's rollback exactly: advance by the
         # accepted+1 commit, clamped at max_len
@@ -914,6 +967,49 @@ class DecodeEngine:
             out["paged"] = (0.0 if not t
                             else self.kv_stats["paged_rows"] * row / t)
         return out
+
+    # -- flight-recorder state summary -------------------------------------
+
+    def flight_state(self):
+        """JSON-ready engine state for a flight dump: the slot table
+        (per-slot lengths + mapped page ids), page-pool occupancy, and
+        the watchdog compile counts.  Paged engines read only host
+        state; the slotted layout's lengths live on DEVICE — and in the
+        strict-recompile crash this dump exists for, the offending call
+        has already consumed that donated buffer, so the read is
+        guarded: a deleted-buffer error costs the lengths field, never
+        the rest of the summary."""
+        try:
+            lengths = [int(x) for x in self.slot_lengths()]
+        except Exception as e:    # donated-away device buffer mid-crash
+            lengths = "unavailable: %r" % (e,)
+        st = {
+            "paged": self.paged,
+            "num_slots": self.num_slots,
+            "max_len": self.max_len,
+            "kv_dtype": str(self.kv_dtype),
+            "spec_k": self.spec_k,
+            "slot_lengths": lengths,
+            "compile_counts": {
+                "decode": self.decode_compile_count,
+                "prefill": self.prefill_compile_count,
+                "verify": self.verify_compile_count,
+            },
+        }
+        if self.paged:
+            al = self._alloc
+            st.update(
+                num_pages=self.num_pages,
+                page_size=self.page_size,
+                pages_used=al.pages_used(),
+                pages_free=al.pages_free(),
+                pages_cached=al.pages_cached(),
+                slot_pages={
+                    str(i): [int(al.table[i, j])
+                             for j in np.nonzero(al.mapped[i])[0]]
+                    for i in range(self.num_slots)},
+            )
+        return st
 
     # -- compile accounting (the "compiles exactly once" contract) ---------
 

@@ -42,6 +42,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..observability import tracing as _tracing
+
 __all__ = ["PageAllocator", "PagePoolExhausted"]
 
 
@@ -61,7 +63,12 @@ def _digest(prev: bytes, tokens: np.ndarray, partial: bool) -> bytes:
 
 class PageAllocator:
     def __init__(self, num_pages: int, num_slots: int, max_pages: int,
-                 page_size: int):
+                 page_size: int, tracer=None):
+        # page-lifecycle events (prefix share / CoW remap / reclaim) land
+        # on the tracer's engine lane; the no-op tracer costs one empty
+        # call per event (tracing.py discipline)
+        self._tracer = (tracer if tracer is not None
+                        else _tracing.default_tracer())
         self.num_pages = int(num_pages)
         self.num_slots = int(num_slots)
         self.max_pages = int(max_pages)
@@ -122,6 +129,8 @@ class PageAllocator:
             pid = next(iter(self._cached))
             del self._cached[pid]
             self._purge_hashes(pid)
+            self._tracer.instant("pages.reclaim", page=pid,
+                                 cached_left=len(self._cached))
         else:
             raise PagePoolExhausted(
                 "page pool exhausted: all %d pages are mapped"
@@ -144,6 +153,8 @@ class PageAllocator:
             self._cached.pop(pid, None)
         self.refcount[pid] += 1
         self.map(slot, idx, pid)
+        self._tracer.instant("pages.prefix_share", page=pid, slot=slot,
+                             refcount=int(self.refcount[pid]))
 
     def _release(self, pid: int):
         self.refcount[pid] -= 1
@@ -201,6 +212,8 @@ class PageAllocator:
         self.table[slot, idx] = new_pid
         self._release(old)
         self._device_table = None
+        self._tracer.instant("pages.cow_remap", slot=slot, old=old,
+                             new=int(new_pid))
         return old
 
     # -- prefix hashing ----------------------------------------------------

@@ -55,6 +55,16 @@ States of a slot: ``free`` → (admit: begin prefill) → ``prefilling`` →
 is strict FIFO over the waiting queue.  Slotted engines
 (``paged=False``) keep the PR-5 one-shot bucketed prefill.
 
+**Request-scoped tracing (ISSUE 9).**  ``submit()`` mints a
+``trace_id`` (threaded onto the :class:`RequestResult`) and opens a
+``request`` root span; admission, each prefill chunk, each decode/
+spec-verify iteration, preemption (``preempted`` event + ``requeue``
+span + ``rework``-tagged recompute chunks), prefix hits, and finish all
+land on that lane.  With tracing disabled (the default) the tracer is
+the no-op singleton by identity and the decode hot loop spends nothing
+(PR-6-style acceptance test); ``python -m paddle_tpu.observability
+trace-report`` reconstructs the per-request timelines.
+
 Per-request timing is recorded for the serving metrics the bench emits:
 TTFT (submit → first token — still INCLUDES queue wait, for continuity
 with the PR-5 trajectory), ``queue_wait`` (submit → admission, reported
@@ -75,6 +85,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observability import registry as _metrics
+from ..observability import tracing as _tracing
 from .engine import PagePoolExhausted
 from .spec import propose as _propose_draft
 
@@ -110,6 +121,8 @@ class RequestResult:
     spec_accepted: int = 0               # draft tokens the verify step
                                          # accepted (rate = accepted /
                                          # proposed; 0/0 when spec off)
+    trace_id: int = 0                    # request lane in the span trace
+                                         # (ISSUE 9; 0 = tracing disabled)
 
 
 class _ActiveSlot:
@@ -156,7 +169,7 @@ class ContinuousBatchingScheduler:
     # recompute and keeps run()'s termination argument trivial
     max_preemptions = 3
 
-    def __init__(self, engine):
+    def __init__(self, engine, tracer=None):
         self.engine = engine
         self.waiting: deque = deque()
         self.slots: List[Optional[_ActiveSlot]] = [None] * engine.num_slots
@@ -164,6 +177,19 @@ class ContinuousBatchingScheduler:
         self._next_rid = 0
         self._admit_seq = 0
         self._submit_t: Dict[int, float] = {}
+        # request-scoped tracing (ISSUE 9): a trace_id minted at submit,
+        # a root "request" span, and per-phase child spans.  With tracing
+        # disabled (the default) the tracer is the module no-op singleton
+        # BY IDENTITY and every call below is an empty method — the
+        # PR-6-style acceptance test asserts it.  The decode hot loop
+        # additionally short-circuits on `_tron` so the per-slot span
+        # bookkeeping costs nothing when off.
+        self._tracer = (tracer if tracer is not None
+                        else _tracing.default_tracer())
+        self._tron = bool(self._tracer.enabled)
+        self._trace_ids: Dict[int, int] = {}       # rid -> trace lane
+        self._req_spans: Dict[int, object] = {}    # rid -> root span
+        self._wait_spans: Dict[int, object] = {}   # rid -> queue/requeue
         # rid -> parked _ActiveSlot (evicted, waiting to resume) and
         # rid -> times evicted; see _preempt()
         self._preempted: Dict[int, _ActiveSlot] = {}
@@ -210,6 +236,17 @@ class ContinuousBatchingScheduler:
         self._next_rid += 1
         self._submit_t[req.rid] = time.perf_counter()
         self.waiting.append(req)
+        # the trace is born HERE: root "request" span + the initial
+        # "queue" child (ended at admission).  No-op identity calls when
+        # tracing is disabled.
+        tid = self._tracer.new_trace()
+        root = self._tracer.span(
+            "request", trace_id=tid, rid=req.rid,
+            prompt_len=int(prompt.size),
+            max_new_tokens=int(req.max_new_tokens))
+        self._trace_ids[req.rid] = tid
+        self._req_spans[req.rid] = root
+        self._wait_spans[req.rid] = self._tracer.span("queue", parent=root)
         self._m_queue_depth.set(len(self.waiting))
         return req.rid
 
@@ -231,7 +268,13 @@ class ContinuousBatchingScheduler:
             queue_wait=act.queue_wait,
             prefix_hit_tokens=act.prefix_hit_tokens,
             spec_proposed=act.spec_proposed,
-            spec_accepted=act.spec_accepted)
+            spec_accepted=act.spec_accepted,
+            trace_id=self._trace_ids.pop(act.req.rid, 0))
+        ws = self._wait_spans.pop(act.req.rid, None)
+        if ws is not None:
+            ws.end()
+        self._req_spans.pop(act.req.rid, _tracing.NOOP_SPAN).end(
+            reason=reason, tokens=len(act.generated))
         self.slots[idx] = None
         self.engine.free_slot(idx)     # paged: pages back to the pool
         self._preempt_count.pop(act.req.rid, None)
@@ -280,6 +323,12 @@ class ContinuousBatchingScheduler:
         self.waiting.appendleft(act.req)
         self._submit_t[rid] = act.submit_t
         self._preempted[rid] = act
+        # trace: mark the eviction on the request lane and open the
+        # "requeue" rework-wait span (ended at re-admission)
+        root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
+        root.event("preempted", slot=idx, generated=len(act.generated))
+        self._wait_spans[rid] = self._tracer.span("requeue", parent=root,
+                                                  rework=True)
         self._m_preempt.inc()
         self._m_queue_depth.set(len(self.waiting))
 
@@ -324,6 +373,9 @@ class ContinuousBatchingScheduler:
             top_k=req.top_k, top_p=req.top_p)
         if task.shared_pages:
             self._m_prefix_hits.inc(task.shared_pages)
+            self._req_spans.get(req.rid, _tracing.NOOP_SPAN).event(
+                "prefix_hit", pages=task.shared_pages,
+                tokens=task.shared_tokens)
         return task
 
     def admit(self) -> int:
@@ -342,6 +394,14 @@ class ContinuousBatchingScheduler:
             resumed = self._preempted.pop(req.rid, None)
             order = self._admit_seq
             self._admit_seq += 1
+            # close the wait span (initial "queue", or a preemption's
+            # "requeue") and mark the admission on the request lane
+            ws = self._wait_spans.pop(req.rid, None)
+            if ws is not None:
+                ws.end()
+            root = self._req_spans.get(req.rid, _tracing.NOOP_SPAN)
+            root.event("readmitted" if resumed is not None else "admitted",
+                       slot=idx)
             if resumed is not None:
                 # recompute-resume a preempted request: re-prefill
                 # prompt + generated so the next sampled token continues
@@ -371,9 +431,12 @@ class ContinuousBatchingScheduler:
             else:
                 self._m_bucket_hits.labels(
                     bucket=self.engine.bucket_for(req.prompt.size)).inc()
+                sp = self._tracer.span("prefill", parent=root, slot=idx)
                 tok, _logits = self.engine.prefill(
                     idx, req.prompt, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p)
+                sp.end()
+                root.event("first_token")
                 act = _ActiveSlot(req, submit_t, queue_wait, order)
                 act.first_token(tok, time.perf_counter())
                 self.slots[idx] = act
@@ -394,6 +457,17 @@ class ContinuousBatchingScheduler:
             if act is None or act.prefill_task is None:
                 continue
             task = act.prefill_task
+            rid = act.req.rid
+            root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
+            # chunks run after a preemption are recompute REWORK (the
+            # re-prefill of prompt + generated) — tagged so the trace
+            # analyzer can attribute them separately from first-admission
+            # prefill (rid stays in _preempt_count until finish)
+            sp = (self._tracer.span("prefill_chunk", parent=root,
+                                    pos=task.pos, rework=True)
+                  if rid in self._preempt_count else
+                  self._tracer.span("prefill_chunk", parent=root,
+                                    pos=task.pos))
             t0 = time.perf_counter()
             while True:
                 try:
@@ -403,6 +477,7 @@ class ContinuousBatchingScheduler:
                     if not self._evict_for_pages(idx):
                         done = None    # requester itself was retired
                         break
+            sp.end()
             if done is None:
                 continue
             now = time.perf_counter()
@@ -410,6 +485,8 @@ class ContinuousBatchingScheduler:
             n += 1
             if done:
                 act.prefill_task = None
+                if act.first_tok_t is None:
+                    root.event("first_token")
                 act.first_token(task.first_token, now)
                 self._check_finished(idx, self.engine.slot_lengths())
         return n
@@ -463,7 +540,10 @@ class ContinuousBatchingScheduler:
                      np.asarray(act.generated, np.int32)])
                 drafts[i], _hit = _propose_draft(
                     hist, spec_k, getattr(self.engine, "spec_ngram", 3))
-        t0 = time.perf_counter()
+        # ONE clock read per boundary, in ns: the step time feeds the
+        # histogram AND stamps every involved request's trace span with
+        # the SAME interval, so trace-report TPOT reproduces the metric
+        t0_ns = time.perf_counter_ns()
         if spec_k:
             emitted, counts, _logits = self.engine.decode_spec(
                 tokens, drafts, active, temps, top_ks, top_ps,
@@ -472,7 +552,9 @@ class ContinuousBatchingScheduler:
             next_tok, _logits = self.engine.decode(tokens, active, temps,
                                                    top_ks, top_ps,
                                                    pages_ready=True)
-        t1 = time.perf_counter()
+        t1_ns = time.perf_counter_ns()
+        step_s = (t1_ns - t0_ns) * 1e-9
+        t1 = t1_ns * 1e-9                      # last_t bookkeeping
         lengths = self.engine.slot_lengths()   # ONE fetch per step
         n = 0
         spec_prop = spec_acc = 0               # per-ITERATION counter incs
@@ -497,14 +579,23 @@ class ContinuousBatchingScheduler:
             else:
                 emit = [int(next_tok[i])]
             act.generated.extend(emit)
-            act.decode_s += t1 - t0
+            act.decode_s += step_s
             act.decode_steps += len(emit)   # TPOT = secs per token
             act.last_t = t1
             n += len(emit)
+            if self._tron:
+                # one span per involved request per iteration, stamped
+                # with the shared step interval; `tokens` is the
+                # decode-committed count (post-truncation), matching the
+                # TPOT accounting exactly
+                self._tracer.add_span(
+                    "spec_verify" if spec_k else "decode", t0_ns, t1_ns,
+                    parent=self._req_spans.get(act.req.rid),
+                    tokens=len(emit))
             self._check_finished(i, lengths)
         # per-ITERATION metrics (not per token): one histogram observe,
         # one counter inc, one gauge set per batched step
-        self._m_decode_step.observe(t1 - t0)
+        self._m_decode_step.observe(step_s)
         self._m_tokens.inc(n)
         if spec_prop:
             self._m_spec_prop.inc(spec_prop)

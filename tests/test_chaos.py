@@ -911,3 +911,173 @@ def test_forced_found_inf_skips_update_and_sets_flag():
     np.testing.assert_array_equal(net.weight.numpy(), w_before)  # skipped
     assert scaler.get_loss_scaling() == 4.0  # dynamic scale backed off
     opt.clear_grad()
+
+
+# ==========================================================================
+# flight recorder (ISSUE 9): black-box dumps on faultpoint/recompile/
+# divergence/preemption triggers, asserted through the PR-4 chaos hooks
+# ==========================================================================
+
+def _load_dump(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _assert_dump_shape(doc, trigger_kind):
+    """Shared flight-dump assertions: the triggering event is IN the
+    ring, the last-N ring is bounded, and the metrics snapshot is
+    catalog-valid (every name declared — the acceptance contract)."""
+    from paddle_tpu.observability import CATALOG
+    assert doc["format"] == "paddle_tpu-flight-v1"
+    assert doc["trigger"]["kind"] == trigger_kind
+    ring = doc["ring"]
+    assert 0 < len(ring) <= doc["ring_capacity"]
+    assert ring[-1]["kind"] == "trigger"  # the trigger is the newest entry
+    assert set(doc["metrics"]) <= set(CATALOG), \
+        "flight metrics snapshot carries undeclared names: %r" \
+        % (set(doc["metrics"]) - set(CATALOG))
+    assert isinstance(doc["engines"], list)
+    assert isinstance(doc["compile_counts"], dict)
+
+
+def test_flight_dump_on_injected_publish_fault(tmp_path):
+    """An injected checkpoint.publish fault that raises must leave a
+    flight dump holding the triggering faultpoint event, the last-N
+    ring, and a catalog-valid metrics snapshot."""
+    from paddle_tpu.observability import flight
+    rec = flight.enable(dir=str(tmp_path / "flight"))
+    try:
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        plan = rb.FaultPlan().inject("checkpoint.publish", fp.DiskFull())
+        with rb.chaos(plan):
+            with pytest.raises(OSError):
+                mgr.save(1, {"v": np.arange(4.0)})
+        plan.assert_all_fired()
+        path = flight.last_dump_path()
+        assert path is not None and os.path.exists(path)
+        doc = _load_dump(path)
+        _assert_dump_shape(doc, "faultpoint")
+        assert doc["trigger"]["site"] == "checkpoint.publish"
+        fires = [e for e in doc["ring"] if e["kind"] == "faultpoint"
+                 and e["site"] == "checkpoint.publish"]
+        assert fires, "the firing event itself must be in the ring"
+    finally:
+        flight.disable()
+
+
+def test_flight_dump_on_strict_recompile(tmp_path, monkeypatch):
+    """A strict-mode RecompileError (the watchdog's fatal kill switch)
+    dumps the flight ring before raising."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.observability import flight
+    from paddle_tpu.observability.watchdog import RecompileError, watch
+    monkeypatch.setenv("PADDLE_TPU_STRICT_COMPILE", "1")
+    rec = flight.enable(dir=str(tmp_path))
+    try:
+        entry = watch("test.flight_entry", jax.jit(lambda x: x + 1),
+                      expected=1)
+        entry(jnp.zeros((2,), jnp.float32))           # budgeted compile
+        with pytest.raises(RecompileError):
+            entry(jnp.zeros((3,), jnp.float32))       # shape drift
+        path = flight.last_dump_path()
+        assert path is not None and os.path.exists(path)
+        doc = _load_dump(path)
+        _assert_dump_shape(doc, "recompile")
+        assert doc["trigger"]["entry"] == "test.flight_entry"
+        assert doc["trigger"]["compile_count"] == 2
+        growth = [e for e in doc["ring"] if e["kind"] == "recompile"]
+        assert len(growth) >= 2  # both compiles metered into the ring
+    finally:
+        flight.disable()
+
+
+def test_flight_dump_on_divergence_ring_exhausted(tmp_path):
+    from paddle_tpu.observability import flight
+    from paddle_tpu.robustness.sentinel import DivergenceSentinel
+    rec = flight.enable(dir=str(tmp_path))
+    try:
+        s = DivergenceSentinel(_StubStep(), min_history=1)
+        with pytest.raises(DivergenceError):
+            s.observe(0, float("nan"))   # no snapshot yet: ring dry
+        doc = _load_dump(flight.last_dump_path())
+        _assert_dump_shape(doc, "divergence")
+    finally:
+        flight.disable()
+
+
+def test_flight_dump_on_preemption_guard_fire(tmp_path):
+    from paddle_tpu.observability import flight
+    rec = flight.enable(dir=str(tmp_path))
+    try:
+        g = PreemptionGuard(install=False)
+        plan = rb.FaultPlan().inject("train.epoch", fp.Preempt())
+        with rb.chaos(plan):
+            fp.faultpoint("train.epoch")
+        plan.assert_all_fired()
+        assert g.preempted
+        doc = _load_dump(flight.last_dump_path())
+        _assert_dump_shape(doc, "preemption")
+        # the guard fired FROM a faultpoint: both events share the ring
+        kinds = [e["kind"] for e in doc["ring"]]
+        assert "faultpoint" in kinds and "preemption" in kinds
+    finally:
+        flight.disable()
+        g.clear()
+
+
+def test_flight_disabled_is_noop(tmp_path):
+    """Registry discipline: with no recorder armed, record() and the
+    crash triggers cost a global None check and write nothing."""
+    from paddle_tpu.observability import flight
+    assert flight.active() is None
+    assert flight.record("anything", x=1) is None
+    assert flight.crash_dump({"kind": "nope"}) is None
+    plan = rb.FaultPlan().inject("checkpoint.publish", fp.DiskFull())
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    with rb.chaos(plan):
+        with pytest.raises(OSError):
+            mgr.save(1, {"v": 1})
+    assert flight.last_dump_path() is None
+    assert not [p for p in os.listdir(str(tmp_path))
+                if p.startswith("flight-")]
+
+
+def test_flight_ring_is_bounded_and_engine_state_collected(tmp_path):
+    from paddle_tpu.observability import flight
+    rec = flight.enable(dir=str(tmp_path), capacity=8)
+    try:
+        for i in range(50):
+            flight.record("tick", i=i)
+        path = rec.dump({"kind": "manual"})
+        doc = _load_dump(path)
+        assert len(doc["ring"]) == 8          # drop-oldest, fixed size
+        assert doc["ring"][-1]["kind"] == "trigger"
+        assert doc["ring"][-2]["i"] == 49     # newest ticks survive
+    finally:
+        flight.disable()
+
+
+def test_flight_dump_deferred_out_of_signal_frame(tmp_path):
+    """A REAL signal's handler must not dump synchronously (it may have
+    interrupted a frame holding the flight/metric locks) — the dump is
+    deferred to the first `preempted` poll, the drain boundary."""
+    from paddle_tpu.observability import flight
+    flight.enable(dir=str(tmp_path))
+    try:
+        g = PreemptionGuard(install=False)
+        g._on_signal(signal.SIGTERM, None)     # handler frame: no dump
+        assert flight.last_dump_path() is None
+        assert g._flag.is_set()
+        assert g.preempted                     # safe frame: dump fires
+        doc = _load_dump(flight.last_dump_path())
+        _assert_dump_shape(doc, "preemption")
+        assert doc["trigger"]["source"] == "signal:SIGTERM"
+        n = len(doc["ring"])
+        assert g.preempted                     # polled again: ONE dump
+        assert len(flight.active().dumps) == 1
+        g.clear()
+        assert g._pending_flight is None
+    finally:
+        flight.disable()
+        g.clear()

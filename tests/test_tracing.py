@@ -1,0 +1,567 @@
+"""Request-scoped tracing + trace-report + flight/bench integration
+(ISSUE 9).
+
+Covers the acceptance criteria:
+* a traced request that experienced a prefix hit, a preemption +
+  re-admission, and (slow variant) spec-verify iterations reconstructs
+  as ONE connected span tree in trace-report, and its TTFT/TPOT
+  attribution agrees with the PR-6 histogram observations for the same
+  run;
+* tracing disabled costs the scheduler hot loop only no-op identity
+  calls (the PR-6-style singleton-identity acceptance test);
+* the tracer guard raises at TRACE time when a jax tracer leaks into a
+  span attr (host-side-only discipline);
+* engine-lane dispatch spans carry the watchdog's compile-count deltas;
+* chrome/JSONL export round trips, request lanes render, the CLI gates
+  on empty/disconnected traces;
+* bench_schema tolerates the new optional `trace` block and old lines
+  still validate (satellite regression).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.tracing import (NOOP_SPAN, NOOP_TRACER,
+                                              Tracer, build_report,
+                                              load_trace)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import bench_schema  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# tracer units (host-side, no jax)
+# ---------------------------------------------------------------------------
+
+def test_span_tree_parent_links_and_attrs():
+    tr = Tracer()
+    t1 = tr.new_trace()
+    root = tr.span("request", trace_id=t1, rid=7)
+    child = tr.span("queue", parent=root)
+    assert child.trace_id == t1 and child.parent_id == root.span_id
+    child.end(queue_wait=0.5)
+    root.event("first_token", n=1)
+    root.end(reason="eos")
+    docs = tr.spans()
+    by_name = {d["name"]: d for d in docs}
+    assert by_name["request"]["attrs"] == {"rid": 7, "reason": "eos"}
+    assert by_name["queue"]["attrs"]["queue_wait"] == 0.5
+    assert by_name["request"]["events"][0]["name"] == "first_token"
+    assert by_name["queue"]["end_ns"] >= by_name["queue"]["start_ns"]
+
+
+def test_add_span_closed_interval_and_span_counts():
+    tr = Tracer()
+    t = tr.new_trace()
+    root = tr.span("request", trace_id=t)
+    tr.add_span("decode", 100, 300, parent=root, tokens=2)
+    tr.instant("pages.reclaim", page=3)
+    counts = tr.span_counts()
+    assert counts[t] == 2
+    d = [s for s in tr.spans() if s["name"] == "decode"][0]
+    assert d["start_ns"] == 100 and d["end_ns"] == 300
+    assert tr.instants()[0]["name"] == "pages.reclaim"
+
+
+def test_end_is_idempotent():
+    tr = Tracer()
+    s = tr.span("x")
+    s.end(end_ns=10)
+    s.end(end_ns=999)
+    assert s.end_ns == 10
+
+
+def test_noop_identity_and_default_disabled():
+    """PR-6-style acceptance: the disabled default tracer and its span
+    are the module singletons BY IDENTITY — an instrumented hot loop
+    pays an attribute load + empty call, nothing else."""
+    assert os.environ.get("PADDLE_TPU_TRACING", "0") in ("0", "")
+    assert tracing.default_tracer() is NOOP_TRACER
+    assert NOOP_TRACER.span("anything", rid=1) is NOOP_SPAN
+    assert NOOP_TRACER.add_span("x", 0, 1) is NOOP_SPAN
+    assert NOOP_TRACER.new_trace() == 0
+    assert NOOP_SPAN.event("e").end().set_attr(a=1) is NOOP_SPAN
+    assert NOOP_TRACER.span_counts() == {}
+    with pytest.raises(RuntimeError, match="disabled"):
+        NOOP_TRACER.export_jsonl("/tmp/never")
+    with pytest.raises(RuntimeError, match="disabled"):
+        # full live signature — must hit the explanatory error, not a
+        # TypeError on the kwarg
+        NOOP_TRACER.export_chrome("/tmp/never", include_profiler=False)
+
+
+def test_default_tracer_env_enables(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACING", "1")
+    old = tracing._DEFAULT
+    tracing._DEFAULT = None
+    try:
+        t = tracing.default_tracer()
+        assert isinstance(t, Tracer) and t.enabled
+    finally:
+        tracing._DEFAULT = old
+
+
+def test_attr_guard_rejects_unfloatable():
+    tr = Tracer()
+    with pytest.raises(RuntimeError, match="host-side only"):
+        tr.span("bad", oops=object())
+
+
+def test_attr_guard_raises_at_jax_trace_time():
+    """The acceptance guard: tracing captured INSIDE a jitted function
+    fails loudly when the jit is traced, not silently at runtime."""
+    import jax
+    import jax.numpy as jnp
+    tr = Tracer()
+
+    def f(x):
+        tr.span("inside_jit", value=x).end()
+        return x + 1
+
+    with pytest.raises(RuntimeError, match="host-side only"):
+        jax.jit(f)(jnp.zeros(()))
+
+
+def test_cap_drops_oldest():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        tr.span("s%d" % i).end()
+    assert tr.span_count == 4 and tr.dropped == 6
+    names = [s["name"] for s in tr.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_cap_drop_oldest_spans_vs_instants():
+    """Eviction is oldest-first ACROSS both buffers: accumulated page
+    instants must not squeeze the span window (and vice versa)."""
+    tr = Tracer(capacity=4)
+    tr.instant("ancient_event")
+    for i in range(4):
+        tr.span("s%d" % i).end()
+    assert tr.dropped == 1
+    assert tr.instants() == []            # the instant was oldest
+    assert [s["name"] for s in tr.spans()] == ["s0", "s1", "s2", "s3"]
+    tr2 = Tracer(capacity=4)
+    tr2.span("oldest_span").end()
+    for i in range(4):
+        tr2.instant("e%d" % i)
+    assert [s["name"] for s in tr2.spans()] == []
+    assert [e["name"] for e in tr2.instants()] == ["e0", "e1", "e2", "e3"]
+
+
+def test_reset_clears_spans_but_ids_never_repeat():
+    tr = Tracer()
+    a = tr.new_trace()
+    tr.span("x", trace_id=a).end()
+    tr.reset()
+    assert tr.span_count == 0
+    assert tr.new_trace() == a + 1
+
+
+def test_jsonl_round_trip_and_torn_line_tolerance(tmp_path):
+    tr = Tracer()
+    root = tr.span("request", trace_id=tr.new_trace(), rid=0)
+    tr.span("queue", parent=root).end()
+    root.end(reason="eos")
+    tr.instant("pages.cow_remap", old=1, new=2)
+    p = str(tmp_path / "t.jsonl")
+    tr.export_jsonl(p)
+    with open(p, "a") as f:
+        f.write('{"kind": "span", "truncated...\n')   # torn tail line
+    spans, events, metas = load_trace(p)
+    assert len(spans) == 2 and len(events) == 1 and len(metas) == 1
+    assert metas[0]["format"] == "paddle_tpu-trace-v1"
+    assert "wall_ts" in metas[0] and "perf_ns" in metas[0]
+
+
+def test_appended_multi_run_file_ids_do_not_collide(tmp_path):
+    """The atexit flush path APPENDS: a second process's ids restart at
+    1, so load_trace must renumber per meta-delimited run segment —
+    otherwise two runs' requests silently merge into one trace."""
+    p = str(tmp_path / "multi.jsonl")
+    for run in range(2):
+        tr = Tracer()
+        root = tr.span("request", trace_id=tr.new_trace(), rid=run * 10)
+        tr.span("decode", parent=root, tokens=1).end()
+        root.event("first_token")
+        root.end(reason="length")
+        tr.export_jsonl(p, mode="a")
+    spans, events, metas = load_trace(p)
+    assert len(metas) == 2 and len(spans) == 4
+    rep = build_report(spans, events)
+    assert rep["totals"]["requests"] == 2
+    assert rep["totals"]["connected"]
+    assert sorted(r["rid"] for r in rep["requests"]) == [0, 10]
+    assert all(r["spans"] == 2 for r in rep["requests"])
+
+
+def test_chrome_export_lanes_and_instants(tmp_path):
+    tr = Tracer()
+    t = tr.new_trace()
+    root = tr.span("request", trace_id=t, rid=0)
+    root.event("prefix_hit", tokens=8)
+    root.end()
+    tr.add_span("engine.decode", 0, 10, compiles=1)
+    p = str(tmp_path / "c.json")
+    tr.export_chrome(p, include_profiler=False)
+    doc = json.load(open(p))
+    ev = doc["traceEvents"]
+    lanes = {e["args"]["name"] for e in ev
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert lanes == {"engine", "request %d" % t}
+    assert any(e["ph"] == "i" and e["name"] == "prefix_hit" for e in ev)
+    xs = [e for e in ev if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"request", "engine.decode"}
+
+
+# ---------------------------------------------------------------------------
+# build_report units (synthetic spans)
+# ---------------------------------------------------------------------------
+
+def _syn_span(name, tid, sid, parent, start, end, attrs=None, events=None):
+    return {"kind": "span", "name": name, "trace_id": tid, "span_id": sid,
+            "parent_id": parent, "start_ns": start, "end_ns": end,
+            "attrs": attrs or {}, "events": events or []}
+
+
+def test_report_attribution_math():
+    S = 1_000_000_000  # 1s in ns
+    spans = [
+        _syn_span("request", 1, 1, None, 0, 10 * S, {"rid": 0,
+                                                     "reason": "length"},
+                  [{"name": "first_token", "ts_ns": 4 * S, "attrs": {}},
+                   {"name": "preempted", "ts_ns": 5 * S, "attrs": {}},
+                   {"name": "prefix_hit", "ts_ns": int(0.5 * S),
+                    "attrs": {"tokens": 16}}]),
+        _syn_span("queue", 1, 2, 1, 0, 1 * S),
+        _syn_span("prefill_chunk", 1, 3, 1, 1 * S, 3 * S),
+        _syn_span("decode", 1, 4, 1, 4 * S, 5 * S, {"tokens": 1}),
+        _syn_span("requeue", 1, 5, 1, 5 * S, 6 * S, {"rework": True}),
+        _syn_span("prefill_chunk", 1, 6, 1, 6 * S, 8 * S,
+                  {"rework": True}),
+        _syn_span("decode", 1, 7, 1, 8 * S, 10 * S, {"tokens": 2}),
+    ]
+    rep = build_report(spans)
+    assert rep["totals"]["requests"] == 1
+    r = rep["requests"][0]
+    assert r["connected"] and r["rid"] == 0
+    assert r["ttft_s"] == pytest.approx(4.0)
+    assert r["queue_s"] == pytest.approx(1.0)
+    assert r["prefill_s"] == pytest.approx(2.0)
+    assert r["decode_s"] == pytest.approx(3.0)
+    assert r["decode_tokens"] == 3
+    assert r["tpot_s"] == pytest.approx(1.0)
+    assert r["rework_s"] == pytest.approx(3.0)   # requeue 1s + rework 2s
+    assert r["prefix_hit_tokens"] == 16 and r["preemptions"] == 1
+    att = r["attribution"]
+    assert att["queue"] == pytest.approx(1 / 9)
+    assert att["prefill"] == pytest.approx(2 / 9)
+    assert att["decode"] == pytest.approx(3 / 9)
+    assert att["rework"] == pytest.approx(3 / 9)
+    assert sum(att.values()) == pytest.approx(1.0)
+    out = tracing.format_report(rep)
+    assert "preempted=1" in out and "prefix_hit=16" in out
+
+
+def test_report_flags_disconnected_tree():
+    spans = [
+        _syn_span("request", 1, 1, None, 0, 10, {"rid": 0}),
+        _syn_span("decode", 1, 2, 99, 2, 4, {"tokens": 1}),  # orphan
+    ]
+    rep = build_report(spans)
+    assert not rep["requests"][0]["connected"]
+    assert not rep["totals"]["connected"]
+    assert "DISCONNECTED" in tracing.format_report(rep)
+
+
+def test_report_ignores_engine_lane_and_rootless_traces():
+    spans = [
+        _syn_span("engine.decode", 0, 1, None, 0, 10),
+        _syn_span("decode", 5, 2, None, 0, 10, {"tokens": 1}),  # no root
+        _syn_span("request", 7, 3, None, 0, 10, {"rid": 3}),
+    ]
+    rep = build_report(spans)
+    assert [r["trace_id"] for r in rep["requests"]] == [7]
+    assert rep["totals"]["engine_spans"] == 1
+
+
+# ---------------------------------------------------------------------------
+# bench_schema: the optional `trace` block (satellite regression)
+# ---------------------------------------------------------------------------
+
+_OLD_LINE = {"metric": "decode_tokens_per_sec", "value": 10.0,
+             "unit": "tok/s"}
+
+
+def test_schema_old_lines_without_trace_still_validate():
+    bench_schema.validate_line(dict(_OLD_LINE), "<t>")
+
+
+def test_schema_accepts_valid_trace_block():
+    line = dict(_OLD_LINE)
+    line["trace"] = {"file": "/tmp/t.jsonl", "spans": 42, "requests": 3,
+                     "engine_spans": 5,
+                     "per_request_spans": {"0": 12, "1": 15}}
+    bench_schema.validate_line(line, "<t>")
+
+
+@pytest.mark.parametrize("bad", [
+    {"spans": 42},                                    # missing requests
+    {"spans": -1, "requests": 0},                     # negative
+    {"spans": True, "requests": 0},                   # bool is not int
+    {"spans": 1, "requests": 1, "file": ""},          # empty file
+    {"spans": 1, "requests": 1,
+     "per_request_spans": {"0": "x"}},                # non-int count
+    [],                                               # not an object
+])
+def test_schema_rejects_malformed_trace_block(bad):
+    line = dict(_OLD_LINE)
+    line["trace"] = bad
+    with pytest.raises(bench_schema.SchemaError):
+        bench_schema.validate_line(line, "<t>")
+
+
+# ---------------------------------------------------------------------------
+# scheduler/engine integration (jax)
+# ---------------------------------------------------------------------------
+
+def _tiny_model(seed=0):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(tracer=None, **kw):
+    from paddle_tpu.serving.engine import DecodeEngine
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("seed", 0)
+    return DecodeEngine(_tiny_model(), tracer=tracer, **kw)
+
+
+def test_scheduler_disabled_tracing_is_noop_identity():
+    """Acceptance: with tracing disabled the scheduler/engine hold the
+    no-op singletons BY IDENTITY; results carry trace_id 0 and no span
+    is recorded anywhere."""
+    from paddle_tpu.serving.scheduler import ContinuousBatchingScheduler
+    eng = _engine()
+    sched = ContinuousBatchingScheduler(eng)
+    assert sched._tracer is NOOP_TRACER and not sched._tron
+    assert eng._tracer is NOOP_TRACER
+    assert eng._alloc._tracer is NOOP_TRACER
+    assert sched._tracer.span("x") is NOOP_SPAN
+
+
+def _drive_preempted_prefix_hit(tracer, spec_k=0):
+    """The acceptance scenario: request X prefix-hits a registered
+    prompt, is preempted mid-decode, re-admits (recompute mostly
+    re-hitting its own cached pages), and finishes.  Returns
+    (scheduler, rid_x, results)."""
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+    eng = _engine(tracer=tracer, num_slots=2, max_len=64, page_size=8,
+                  spec_k=spec_k)
+    sched = ContinuousBatchingScheduler(eng, tracer=tracer)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 50257, (24,)).astype(np.int32) % 257
+    # run 1: register the prompt's pages in the prefix cache
+    sched.submit(Request(prompt=prompt, max_new_tokens=2,
+                         temperature=0.0))
+    sched.run()
+    # run 2: X prefix-hits; Y is independent load.  A verify step can
+    # commit up to spec_k+1 tokens, so the budget scales with k to keep
+    # X alive past the preemption point below
+    budget = 8 + 8 * spec_k
+    rx = sched.submit(Request(prompt=prompt, max_new_tokens=budget,
+                              temperature=0.0))
+    ry = sched.submit(Request(prompt=rng.integers(0, 257, (16,)),
+                              max_new_tokens=budget, temperature=0.0))
+    # admit + prefill + a few decode iterations
+    for _ in range(3):
+        sched.step()
+    idx = next(i for i, a in enumerate(sched.slots)
+               if a is not None and a.req.rid == rx)
+    assert sched.slots[idx].generated, "X should be decoding by now"
+    # deterministic preemption of X: the same parking/requeue path
+    # _evict_for_pages drives under pool pressure, including its
+    # preempt-count bookkeeping (which tags resume chunks as rework)
+    sched._preempt_count[rx] = sched._preempt_count.get(rx, 0) + 1
+    sched._preempt(idx)
+    results = sched.run()
+    assert results[rx].prefix_hit_tokens > 0
+    return sched, rx, results
+
+
+def test_traced_request_prefix_hit_preemption_reconstructs():
+    """The tentpole acceptance (non-spec half): one connected span tree
+    per request; X's tree shows the prefix hit, the preemption +
+    re-admission rework, and TTFT/TPOT that agree with the PR-6
+    histograms and the RequestResult for the same run."""
+    from paddle_tpu import observability as obs
+    obs.default_registry().reset()
+    tr = Tracer()
+    sched, rx, results = _drive_preempted_prefix_hit(tr)
+
+    rep = build_report(tr.spans(), tr.instants())
+    assert rep["totals"]["connected"], "every span tree must be connected"
+    by_rid = {r["rid"]: r for r in rep["requests"]}
+    x = by_rid[rx]
+    assert x["connected"] and x["spans"] > 3
+    assert x["preemptions"] == 1
+    assert x["prefix_hit_tokens"] == results[rx].prefix_hit_tokens
+    assert x["rework_s"] > 0 and x["rework_prefill_s"] > 0
+    # decode-committed tokens exclude every prefill-sampled one: the
+    # initial first token AND each completed resume's recompute sample
+    assert x["decode_tokens"] == \
+        results[rx].tokens.size - 1 - x["preemptions"]
+
+    # TTFT/TPOT attribution agrees with the RequestResult...
+    assert x["ttft_s"] == pytest.approx(results[rx].ttft, abs=0.05)
+    assert x["tpot_s"] == pytest.approx(results[rx].tpot, rel=1e-6)
+    # ...and with the PR-6 histogram observations for the same run
+    h_ttft = obs.histogram("serving.ttft_seconds")
+    h_tpot = obs.histogram("serving.tpot_seconds")
+    trace_ttfts = [r["ttft_s"] for r in rep["requests"]
+                   if r["ttft_s"] is not None]
+    assert h_ttft.count == len(trace_ttfts)
+    assert h_ttft.sum == pytest.approx(sum(trace_ttfts),
+                                       abs=0.05 * max(len(trace_ttfts), 1))
+    trace_tpots = [r["tpot_s"] for r in rep["requests"]
+                   if r["decode_tokens"]]
+    assert h_tpot.count == len(trace_tpots)
+    assert h_tpot.sum == pytest.approx(sum(trace_tpots), rel=1e-6)
+
+    # trace_id threads through to the results (satellite)
+    tids = {r.trace_id for r in results.values()}
+    assert 0 not in tids and len(tids) == len(results)
+
+    # engine lane: dispatch spans carry the watchdog compile deltas —
+    # exactly ONE decode compile across the whole churny run
+    eng_spans = [s for s in tr.spans() if s["trace_id"] == 0]
+    dec = [s for s in eng_spans if s["name"] == "engine.decode"]
+    assert dec and sum(s["attrs"]["compiles"] for s in dec) == 1
+    assert all(s["attrs"]["compile_count"] == 1 for s in dec)
+    # pages.py lifecycle events land on the engine lane as instants
+    # (the retired registrant's pages come back at refcount 1, so this
+    # scenario shares without copy-on-write — CoW has its own test)
+    assert "pages.prefix_share" in {e["name"] for e in tr.instants()}
+    # ...and the report's totals summarize them by name
+    assert rep["totals"]["instants"].get("pages.prefix_share", 0) > 0
+
+
+@pytest.mark.slow
+def test_cow_dispatch_span_and_page_events():
+    """A LIVE sharer forces the capped-full-hit rewrite to copy-on-write:
+    the engine.cow_copy dispatch span and the pages.cow_remap instant
+    both land on the engine lane."""
+    tr = Tracer()
+    eng = _engine(tracer=tr, num_slots=2, max_len=64, page_size=8)
+    rng = np.random.default_rng(1)
+    # length == 2 full pages: the n-1 cap lands INSIDE the shared second
+    # page, so the final-token chunk writes a refcount-2 page
+    prompt = rng.integers(0, 257, (16,))
+    eng.prefill(0, prompt)    # registers; slot 0 stays LIVE
+    eng.prefill(1, prompt)    # full hit -> shares live pages -> CoW
+    cow = [s for s in tr.spans() if s["name"] == "engine.cow_copy"]
+    assert cow and sum(s["attrs"]["compiles"] for s in cow) == 1
+    ev = {e["name"] for e in tr.instants()}
+    assert "pages.cow_remap" in ev and "pages.prefix_share" in ev
+
+
+@pytest.mark.slow
+def test_traced_spec_verify_request_full_acceptance():
+    """The full acceptance scenario: prefix hit + preemption +
+    re-admission + SPEC-VERIFY iterations, one connected tree, verify
+    compiled once, attribution consistent with the histograms."""
+    from paddle_tpu import observability as obs
+    obs.default_registry().reset()
+    tr = Tracer()
+    sched, rx, results = _drive_preempted_prefix_hit(tr, spec_k=2)
+
+    rep = build_report(tr.spans(), tr.instants())
+    assert rep["totals"]["connected"]
+    x = {r["rid"]: r for r in rep["requests"]}[rx]
+    assert x["preemptions"] == 1 and x["prefix_hit_tokens"] > 0
+    assert x["spec_verify_iterations"] > 0
+    assert x["decode_tokens"] == \
+        results[rx].tokens.size - 1 - x["preemptions"]
+    assert x["tpot_s"] == pytest.approx(results[rx].tpot, rel=1e-6)
+    assert x["ttft_s"] == pytest.approx(results[rx].ttft, abs=0.05)
+    h_tpot = obs.histogram("serving.tpot_seconds")
+    trace_tpots = [r["tpot_s"] for r in rep["requests"]
+                   if r["decode_tokens"]]
+    assert h_tpot.count == len(trace_tpots)
+    assert h_tpot.sum == pytest.approx(sum(trace_tpots), rel=1e-6)
+    ver = [s for s in tr.spans() if s["name"] == "engine.spec_verify"]
+    assert ver and sum(s["attrs"]["compiles"] for s in ver) == 1
+
+
+@pytest.mark.slow
+def test_trace_report_cli_round_trip(tmp_path, capsys):
+    """trace-report over a real exported run: table + json + chrome, and
+    the hard-rc gates (exit 2 on empty, 0 on a good trace)."""
+    from paddle_tpu.observability.__main__ import main as cli
+    tr = Tracer()
+    _sched, rx, _results = _drive_preempted_prefix_hit(tr)
+    p = str(tmp_path / "trace.jsonl")
+    tr.export_jsonl(p)
+
+    assert cli(["trace-report", "--file", p]) == 0
+    out = capsys.readouterr().out
+    assert "trees connected" in out and "preempted=1" in out
+
+    chrome = str(tmp_path / "chrome.json")
+    assert cli(["trace-report", "--file", p, "--format", "json",
+                "--chrome", chrome]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["totals"]["connected"]
+    doc = json.load(open(chrome))
+    lanes = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"
+             and str(e["args"]["name"]).startswith("request ")]
+    assert lanes, "chrome export must carry request lanes"
+
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert cli(["trace-report", "--file", empty]) == 2
+    assert cli(["trace-report", "--file", empty, "--allow-empty"]) == 0
+    assert cli(["trace-report", "--file",
+                str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_trace_report_cli_disconnected_exits_1(tmp_path, capsys):
+    p = str(tmp_path / "bad.jsonl")
+    with open(p, "w") as f:
+        for d in [_syn_span("request", 1, 1, None, 0, 10, {"rid": 0}),
+                  _syn_span("decode", 1, 2, 99, 2, 4, {"tokens": 1})]:
+            f.write(json.dumps(d) + "\n")
+    from paddle_tpu.observability.__main__ import main as cli
+    assert cli(["trace-report", "--file", p]) == 1
+    assert "DISCONNECTED" in capsys.readouterr().err
+
+
+def test_tracer_spans_feed_flight_ring(tmp_path):
+    """tracing -> flight composition: while the recorder is armed,
+    every finished span lands in the black-box ring."""
+    from paddle_tpu.observability import flight
+    flight.enable(dir=str(tmp_path))
+    try:
+        tr = Tracer()
+        tr.span("request", trace_id=tr.new_trace(), rid=0).end(
+            reason="eos")
+        path = flight.crash_dump({"kind": "manual"})
+        doc = json.load(open(path))
+        spans = [e for e in doc["ring"] if e["kind"] == "span"]
+        assert spans and spans[0]["name"] == "request"
+        assert spans[0]["attrs"]["reason"] == "eos"
+    finally:
+        flight.disable()

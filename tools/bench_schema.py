@@ -102,6 +102,31 @@ def validate_compile_counts(cc: Any, path: str, where: str):
                  "least once" % (where, entry, count))
 
 
+def validate_trace_block(t: Any, path: str):
+    """The ISSUE-9 optional ``trace`` block (bench_decode --trace-file):
+    span counts per request plus the exported file path.  Optional —
+    old lines without it validate clean (regression-tested)."""
+    _require(isinstance(t, dict), path, "'trace' must be an object")
+    for k in ("spans", "requests"):
+        _require(k in t, path, "trace block missing %r" % k)
+        _require(isinstance(t[k], int) and not isinstance(t[k], bool)
+                 and t[k] >= 0, path,
+                 "trace[%r] must be a non-negative int, got %r"
+                 % (k, t[k]))
+    if "file" in t:
+        _require(isinstance(t["file"], str) and t["file"], path,
+                 "trace['file'] must be a non-empty string")
+    if "per_request_spans" in t:
+        prs = t["per_request_spans"]
+        _require(isinstance(prs, dict), path,
+                 "trace['per_request_spans'] must be an object")
+        for rid, n in prs.items():
+            _require(isinstance(n, int) and not isinstance(n, bool)
+                     and n >= 0, path,
+                     "trace.per_request_spans[%r] must be a non-negative "
+                     "int, got %r" % (rid, n))
+
+
 def validate_line(doc: Any, path: str,
                   expect_compile_once: List[str] = ()):
     _require(isinstance(doc, dict), path, "bench line must be a JSON object")
@@ -112,6 +137,8 @@ def validate_line(doc: Any, path: str,
     if "vs_baseline" in doc:
         _require(_is_num(doc["vs_baseline"]), path,
                  "'vs_baseline' must be a number")
+    if "trace" in doc:
+        validate_trace_block(doc["trace"], path)
     if "compile_counts" in doc:
         validate_compile_counts(doc["compile_counts"], path,
                                 "compile_counts")
