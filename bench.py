@@ -108,6 +108,15 @@ def main():
         },
         "compile_counts": obs.compile_counts(),
     }
+    # cost block (ISSUE 11): XLA's own FLOPs/HBM-bytes/peak of THIS
+    # compiled step, with MFU and HBM-bandwidth utilization derived from
+    # the p50 step wall time when on-chip (the round-7+ headline number —
+    # PERF.md).  CPU smoke lines carry null utilizations: the trajectory
+    # gate validates their shape and never perf-gates them.  One extra
+    # compile, strictly AFTER the timed loop.
+    result["cost"] = obs.costs.cost_block(
+        step.cost_report((x, x)), step_seconds=h.percentile(0.50),
+        on_chip=on_tpu)
     print(json.dumps(result))
 
 

@@ -169,6 +169,9 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
                 "count": h.count}
 
     kv = engine.kv_bytes_per_token()
+    # the decode-side program this line reports (the verify program on a
+    # speculative engine — the single-token decode never runs there)
+    cost_entry = "serving.spec_verify" if spec else "serving.decode"
     from paddle_tpu.kernels import autotune as at
     result = {
         "metric": "decode_tokens_per_sec",
@@ -212,6 +215,16 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
             "compile_counts": {k: v for k, v in
                                obs.compile_counts().items() if v > 0},
         },
+        # cost block (ISSUE 11): XLA cost/memory analysis of the
+        # decode-side program that served the drain, utilizations
+        # derived from the p50 batched-step wall time when on-chip; CPU
+        # smoke carries nulls (shape-only).  only= prices just this one
+        # program, AFTER the timed drain.
+        "cost": obs.costs.cost_block(
+            engine.cost_reports(only=(cost_entry,))[cost_entry],
+            step_seconds=obs.histogram(
+                "serving.decode_step_seconds").percentile(0.50),
+            on_chip=on_tpu),
         "config": {
             "model": model_name,
             "backend": jax.default_backend(),

@@ -20,6 +20,9 @@ TPU504   vmem_budget        Pallas BlockSpec working set fits per-core
                             pre-compile)
 TPU505   purity             no dead/duplicated expensive subcomputation,
                             no stray host callbacks
+TPU506   hbm_budget         compiled peak-HBM (XLA memory_analysis
+                            derived bound) fits the declared per-program
+                            budget — TPU504's post-compile complement
 =======  =================  =============================================
 
 CLI: ``python -m paddle_tpu.analysis --trace [--select TPU504] --strict``.
@@ -35,18 +38,19 @@ from .vmem import (VMEM_LIMIT_BYTES, VMEM_RESERVE_BYTES, KernelFootprint,
                    VmemBudgetPass, fits_vmem, footprint_of_callable,
                    pallas_footprints)
 from .purity import CALLBACK_PRIMS, EXPENSIVE_PRIMS, PurityPass
+from .hbm_budget import HBM_BUDGETS, HbmBudgetPass
 from .programs import ProgramSkip, build_programs, builder_names
 
 #: default trace pass set, in rule-id order.
 TRACE_PASSES = [DtypeLeakPass, DonationPass, CollectiveOrderPass,
-                VmemBudgetPass, PurityPass]
+                VmemBudgetPass, PurityPass, HbmBudgetPass]
 
 TRACE_RULES = {p.rule: p for p in TRACE_PASSES}
 
 __all__ = ["TraceProgram", "TracePass", "TraceAnalyzer", "EqnSite",
            "walk_eqns", "op_paths", "subjaxprs",
            "DtypeLeakPass", "DonationPass", "CollectiveOrderPass",
-           "VmemBudgetPass", "PurityPass",
+           "VmemBudgetPass", "PurityPass", "HbmBudgetPass", "HBM_BUDGETS",
            "F32_ACCUM_OPS", "COLLECTIVE_PRIMS", "CALLBACK_PRIMS",
            "EXPENSIVE_PRIMS", "VMEM_LIMIT_BYTES", "VMEM_RESERVE_BYTES",
            "KernelFootprint", "pallas_footprints", "footprint_of_callable",

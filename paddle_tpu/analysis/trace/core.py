@@ -37,12 +37,21 @@ class TraceProgram:
       recurse through pjit/shard_map/cond/scan/while/pallas_call).
     * ``lowered_text`` — StableHLO of the lowered entry when the program
       has one (kernels are audited at the jaxpr level only).
+    * ``lowered`` — the ``jax.stages.Lowered`` object itself when the
+      builder lowered one (the text above is derived from it): TPU506
+      and the cost CLI compile it for XLA cost/memory analysis.
+    * ``lower_thunk`` — zero-arg callable producing a Lowered for
+      programs kept at the jaxpr level (Pallas kernel variants), so
+      cost extraction can lower on demand without the registry paying
+      30+ lowerings up front on every audit run.
     * ``meta`` — program facts the passes check against:
         ``donated_invars``   tuple of bools per flat entry input
         ``donate_labels``    {flat input index: human label} for findings
         ``mesh_axes``        {axis name: size} declared for the program
         ``bf16_region``      True when compute is declared bf16 (TPU501)
         ``allow_callbacks``  True to exempt host callbacks (TPU505)
+        ``hbm_budget``       per-program peak-HBM budget bytes (TPU506;
+                             overrides the pass's declared table)
         ``kind``             "train_step" | "pipeline" | "decode" |
                              "pallas_kernel" | "fixture"
     """
@@ -51,6 +60,8 @@ class TraceProgram:
     jaxpr: Any
     lowered_text: Optional[str] = None
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    lowered: Any = None
+    lower_thunk: Optional[Callable[[], Any]] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,107 @@
+"""TPU506 — compiled peak-HBM vs a declared per-program budget.
+
+TPU504 prices a Pallas kernel's VMEM working set *before* compile; this
+pass is its post-compile HBM complement: the canonical registry's
+programs are compiled (off their stored ``lowered`` entries — nothing
+re-traces) and XLA's own ``memory_analysis()`` yields the derived peak
+buffer bound ``argument + output + temp - alias``
+(:func:`paddle_tpu.observability.costs.report_from_compiled`).  A
+program whose name appears in :data:`HBM_BUDGETS` (or whose meta
+declares ``hbm_budget``) must fit its budget — so a perf PR that
+silently doubles a serving entry's peak HBM fails the audit at the
+program that regressed, instead of OOMing a chip three sessions later.
+
+Budget discipline:
+
+* budgets are **per program as registered** (the registry's tiny
+  configs), sized ~1.6x the measured peak at declaration time — tight
+  enough that a 2x regression can NEVER sail through, loose enough for
+  backend layout jitter (the derived peak excludes generated-code
+  bytes, the one wildly backend-dependent term);
+* a declared budget that cannot be priced is a **finding, not a skip**:
+  a program that lost its lowered entry (or stopped compiling) would
+  otherwise turn the gate silently green;
+* programs without a budget are not findings — declare budgets
+  deliberately, starting with the serving entries (the multi-hundred-MB
+  pools at production scale are exactly where a silent 2x hurts most).
+
+``meta["hbm_budget"]`` overrides the table (fixtures use this).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+from ..core import Finding
+from .core import TracePass, TraceProgram
+
+__all__ = ["HBM_BUDGETS", "HbmBudgetPass"]
+
+#: {program name: peak-HBM budget bytes} for the canonical registry.
+#: Sized ~1.6x the measured CPU-audit peak at declaration (ISSUE 11):
+#: decode_step 603,330 B / prefill_chunk 764,788 B / spec_verify
+#: 598,498 B / cow_copy 139,288 B — re-measure with
+#: ``python -m paddle_tpu.observability programs`` when resizing.
+HBM_BUDGETS: Dict[str, int] = {
+    "serving/decode_step": 1_000_000,
+    "serving/prefill_chunk": 1_250_000,
+    "serving/spec_verify": 1_000_000,
+    "serving/cow_copy": 250_000,
+}
+
+
+class HbmBudgetPass(TracePass):
+    """TPU506: every budgeted program's compiled peak-HBM (derived
+    argument+output+temp-alias bound) fits its declared budget."""
+
+    rule = "TPU506"
+    name = "hbm_budget"
+    description = ("compiled peak-HBM (XLA memory_analysis, derived "
+                   "arg+out+temp-alias bound) fits the declared "
+                   "per-program budget")
+
+    #: the op-path symbol findings key on: the check is whole-program,
+    #: so one stable pseudo-path keeps baseline entries pinnable
+    SYMBOL = "memory/peak_bytes"
+
+    def check(self, program: TraceProgram) -> Iterable[Finding]:
+        budget = program.meta.get("hbm_budget",
+                                  HBM_BUDGETS.get(program.name))
+        if budget is None:
+            return
+        from ...observability import costs as _costs
+        report = _costs.report_for_program(program)
+        if not report.available:
+            # loud by design: a budgeted program that cannot be priced
+            # (lost its lowered entry, stopped compiling) must not turn
+            # the gate silently green
+            yield self.finding(
+                program, self.SYMBOL,
+                "HBM budget %d B declared but the program cannot be "
+                "priced on this backend: %s" % (budget, report.note))
+            return
+        if report.peak_bytes is None:
+            # LOUD, same as unpriceable: a budget was DECLARED for this
+            # program, so a memory_analysis that reports no buffer
+            # sizes (e.g. a jax upgrade renaming the fields) must not
+            # turn the gate silently green — CPU and TPU both report
+            # today, so this finding means extraction broke, not that
+            # the program regressed
+            yield self.finding(
+                program, self.SYMBOL,
+                "HBM budget %d B declared but memory_analysis reports "
+                "no buffer sizes on this backend (cost extraction "
+                "broke, or the backend genuinely lacks the analysis — "
+                "either way the declared budget is unenforceable and "
+                "must not look green)" % budget)
+            return
+        if report.peak_bytes > budget:
+            yield self.finding(
+                program, self.SYMBOL,
+                "peak HBM %d B exceeds the declared budget %d B "
+                "(argument %s + output %s + temp %s - alias %s; budgets "
+                "live in analysis/trace/hbm_budget.py and are sized "
+                "~1.6x the measured peak — a regression this large is a "
+                "real allocation change, not jitter)"
+                % (report.peak_bytes, budget, report.argument_bytes,
+                   report.output_bytes, report.temp_bytes,
+                   report.alias_bytes))

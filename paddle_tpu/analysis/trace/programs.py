@@ -129,7 +129,7 @@ def _build_gpt_train_step() -> List[TraceProgram]:
     lowered = audit_step.lower(*args)
     return [TraceProgram(
         name="gpt_train_step", jaxpr=jaxpr,
-        lowered_text=lowered.as_text(),
+        lowered_text=lowered.as_text(), lowered=lowered,
         meta={"kind": "train_step", "mesh_axes": {},
               "donate_labels": _donate_labels(args)})]
 
@@ -152,7 +152,8 @@ def _build_pipeline_1f1b() -> List[TraceProgram]:
     meta = dict(meta)
     meta["donate_labels"] = _donate_labels(args)
     return [TraceProgram(name="pipeline_1f1b", jaxpr=jaxpr,
-                         lowered_text=lowered.as_text(), meta=meta)]
+                         lowered_text=lowered.as_text(), lowered=lowered,
+                         meta=meta)]
 
 
 @register_builder("gpt_decode")
@@ -190,7 +191,7 @@ def _build_gpt_decode() -> List[TraceProgram]:
     lowered = jitted.lower(state, x1, cache)
     return [TraceProgram(
         name="gpt_decode", jaxpr=jaxpr, lowered_text=lowered.as_text(),
-        meta={"kind": "decode", "mesh_axes": {}})]
+        lowered=lowered, meta={"kind": "decode", "mesh_axes": {}})]
 
 
 @register_builder("serving", prefix="serving/")
@@ -256,6 +257,7 @@ def _build_serving() -> List[TraceProgram]:
             lowered = audit.lower(*args)
         out.append(TraceProgram(
             name=name, jaxpr=jaxpr, lowered_text=lowered.as_text(),
+            lowered=lowered,
             meta={"kind": "serving", "mesh_axes": {},
                   "donate_labels": _donate_labels(args)}))
     return out
@@ -285,8 +287,16 @@ def _build_pallas_kernels() -> List[TraceProgram]:
             seen.add(variant)        # lower the same kernel structure
             fn, args = fam.traceable(cand, key)
             jaxpr = jax.make_jaxpr(fn)(*args)
+
+            def lower_thunk(fn=fn, args=args):
+                # on-demand lowering for cost extraction (the audit
+                # passes stay jaxpr-level): off-chip this prices the
+                # interpret-mode lowering, which the cost CLI labels
+                return jax.jit(fn).lower(*args)
+
             out.append(TraceProgram(
                 name="pallas/%s/%s" % (fam_name, variant), jaxpr=jaxpr,
+                lower_thunk=lower_thunk,
                 meta={"kind": "pallas_kernel", "bf16_region": True,
                       "mesh_axes": {}, "family": fam_name,
                       "variant": variant, "autotune_key": at.key_str(key)}))

@@ -15,6 +15,7 @@ from ..core.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..jit import TrainStep, functional_call
 from ..metric import Metric
+from ..observability import hbm as _hbm
 from ..observability import registry as _metrics
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
@@ -251,6 +252,10 @@ class Model:
                     if len(shape) >= 2:
                         m_tokens.inc(int(shape[0]) * int(shape[1]))
                 logs = {"loss": losses[0]}
+                # HBM-ledger sample at the batch boundary (the loss
+                # fetch above was a real device sync, so live_arrays is
+                # settled here); one global None check while disarmed
+                _hbm.maybe_sample("train.batch")
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
                 it_count += 1
@@ -412,11 +417,14 @@ def flops(net, input_size=None, inputs=None, dtypes=None, custom_ops=None,
     finally:
         if was_training:
             net.train()
-    ca = compiled.cost_analysis() or {}
-    if isinstance(ca, (list, tuple)):
-        # jax <= 0.4.x returns one dict per device; flops are identical
-        # replicas on a single-program compile — take the first
-        ca = ca[0] if ca else {}
+    # ONE cost_analysis parser for the whole repo (incl. the 0.4.x
+    # list-shape compat): observability.costs — the same extraction the
+    # `programs` CLI and TPU506 run on the canonical registry.  strict:
+    # a RAISING cost_analysis must propagate (this API returns a bare
+    # int — a swallowed failure would read as "0 FLOPs", a plausible
+    # wrong answer with no degradation channel)
+    from ..observability.costs import cost_analysis_dict
+    ca = cost_analysis_dict(compiled, strict=True)
     total = int(ca.get("flops", 0))
     if print_detail:
         print(f"FLOPs (XLA cost analysis): {total:,}")

@@ -160,6 +160,22 @@ def _to_host(obj):
     return obj
 
 
+def _host_tree_bytes(obj) -> int:
+    """Bytes the deserialized host-side tree holds (the restore-time
+    transient the HBM ledger reports) — numpy leaves and sharded-leaf
+    pieces; non-array leaves price 0."""
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, _ShardedLeaf):
+        return sum(int(a.nbytes) for _idx, a in obj.shards
+                   if isinstance(a, np.ndarray))
+    if isinstance(obj, dict):
+        return sum(_host_tree_bytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_host_tree_bytes(v) for v in obj)
+    return 0
+
+
 def _from_host(obj, template=None):
     """Rebuild arrays; with a ``template`` leaf carrying a sharding, the
     restored value is device_put back onto that sharding (so a restored
@@ -515,7 +531,16 @@ class CheckpointManager:
                    "; ".join("ckpt-%d: %s: %s" % (s, type(e).__name__, e)
                              for s, e in failures)))
         tmpl = _to_template(template) if template is not None else None
-        out = _from_host(merged, tmpl)
+        # HBM-ledger transient (ISSUE 11): between read and device
+        # placement the whole deserialized tree lives host-side — the
+        # restore-time memory spike an OOM post-mortem wants named.
+        # Gauge set for the placement's duration, zeroed after.
+        from ...observability import hbm as _hbm
+        _hbm.note_restore(_host_tree_bytes(merged))
+        try:
+            out = _from_host(merged, tmpl)
+        finally:
+            _hbm.clear_restore()
         _metrics.histogram("checkpoint.restore_seconds").observe(
             time.perf_counter() - t0)
         return out

@@ -643,6 +643,18 @@ class TrainStep:
         return (self.params, self.buffers, self.opt_state, lr, rng,
                 _unwrap_tree(tuple(batch)))
 
+    def cost_report(self, batch):
+        """XLA cost/memory analysis of THIS step's compiled program
+        (:class:`paddle_tpu.observability.costs.ProgramReport`) — the
+        bench `cost` block's source.  Lowers + compiles once per call
+        (the jit dispatch cache is separate from the AOT path): cold
+        path only — bench.py calls it after the timed loop."""
+        from ..observability import costs as _costs
+        compiled = jax.jit(self._step_fn,
+                           donate_argnums=self._donate_argnums) \
+            .lower(*self.trace_args(batch)).compile()
+        return _costs.report_from_compiled("jit.train_step", compiled)
+
     def __call__(self, *batch):
         rng = _rnd.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)

@@ -23,13 +23,23 @@ subsystem:
   ``trace-report`` timeline/attribution analyzer.  Disabled by default
   (``PADDLE_TPU_TRACING=1`` arms it — no-op identity tracer otherwise).
 * :mod:`.flight` — the black-box flight recorder: a bounded ring of
-  recent span/engine events plus metrics + engine-state snapshots,
-  dumped to a file on DivergenceError / strict RecompileError /
-  preemption-guard fires / faultpoint-raised crashes
+  recent span/engine events plus metrics + engine-state + HBM-ledger
+  snapshots, dumped to a file on DivergenceError / strict
+  RecompileError / preemption-guard fires / faultpoint-raised crashes
   (``PADDLE_TPU_FLIGHT=1`` arms it).
-* CLI: ``python -m paddle_tpu.observability dump|serve|tail|trace-report``
-  over the JSONL snapshot stream (``PADDLE_TPU_METRICS_FILE``) and span
-  trace files.
+* :mod:`.costs` — compiled-program cost reports (ISSUE 11): XLA
+  ``cost_analysis()`` + ``memory_analysis()`` extracted into
+  :class:`~.costs.ProgramReport` for every canonical-registry program
+  and every serving entry, MFU / HBM-bandwidth-utilization derivation,
+  and the schema'd bench ``cost`` block.
+* :mod:`.hbm` — the live HBM ledger: catalog'd gauges for per-device
+  live bytes / engine KV-pool bytes / checkpoint-restore transients,
+  sampled at step boundaries when armed (``PADDLE_TPU_HBM=1``), with
+  chrome-trace counter lanes and flight-dump snapshots.
+* CLI: ``python -m paddle_tpu.observability
+  dump|serve|tail|trace-report|programs`` over the JSONL snapshot
+  stream (``PADDLE_TPU_METRICS_FILE``), span trace files, and the
+  canonical program registry.
 
 Import discipline: this package must stay importable before (and without)
 jax — the registry is pure stdlib; jax-adjacent pieces (profiler marks)
@@ -37,7 +47,7 @@ import lazily.  See OBSERVABILITY.md for the metric catalog and knobs.
 """
 from __future__ import annotations
 
-from . import flight
+from . import costs, flight, hbm
 from .catalog import CATALOG
 from .registry import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
                        Gauge, Histogram, Registry, counter, default_registry,
@@ -53,4 +63,5 @@ __all__ = [
     "RecompileError", "RecompileWarning", "WatchedEntry", "watch",
     "compile_counts",
     "Tracer", "NOOP_TRACER", "NOOP_SPAN", "default_tracer", "flight",
+    "costs", "hbm",
 ]

@@ -4,16 +4,28 @@
     python -m paddle_tpu.observability tail  [--file P] [--follow] [--interval S]
     python -m paddle_tpu.observability serve [--file P] [--port N]
     python -m paddle_tpu.observability trace-report --file T \\
-        [--format table|json] [--chrome OUT] [--allow-empty]
+        [--format table|json] [--chrome OUT] [--allow-empty] [--sli]
+    python -m paddle_tpu.observability programs [patterns] \\
+        [--format table|json]
 
 ``trace-report`` (ISSUE 9) reconstructs per-request timelines from a
 span trace (the JSONL a :class:`~.tracing.Tracer` exports — see
 ``bench_decode.py --trace-file``) and prints TTFT/TPOT attribution
 (queue vs prefill vs decode vs preemption-rework share) per request;
 ``--chrome OUT`` additionally writes the chrome://tracing JSON with one
-lane per request.  Exit 2 when the file holds no request traces (unless
+lane per request; ``--sli`` adds the per-finish-reason p50/p99
+TTFT/TPOT rollup (cross-checked in tests against the ISSUE-6 histograms
+on the same run).  Exit 2 when the file holds no request traces (unless
 ``--allow-empty``), exit 1 when any request's span tree is
 disconnected — CI uses both as hard gates.
+
+``programs`` (ISSUE 11) prices the trace-audit canonical registry with
+XLA's own cost/memory analysis: one FLOPs / bytes-accessed / peak-HBM
+row per program (:mod:`.costs`).  Same operational discipline as the
+``--trace`` analysis CLI: an empty registry exits 2 (never silent
+green), broken builders exit 1, and the process must be launched with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` off-chip so the
+pipeline program gets its mesh (CI does).
 
 ``--file`` defaults to ``$PADDLE_TPU_METRICS_FILE``.  ``dump`` renders the
 newest snapshot (Prometheus text by default); with no file configured it
@@ -177,16 +189,46 @@ def cmd_trace_report(args) -> int:
         print("no request traces in %s (0 spans with a 'request' root)"
               % args.file, file=sys.stderr)
         return 2
+    if args.sli:
+        report["sli"] = tracing.build_sli(report)
     if args.format == "json":
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
         print(tracing.format_report(report))
+        if args.sli:
+            print()
+            print(tracing.format_sli(report["sli"]))
     if not report["totals"]["connected"]:
         print("trace-report: DISCONNECTED span tree(s) — a span's "
               "parent link does not reach its request root",
               file=sys.stderr)
         return 1
     return 0
+
+
+def cmd_programs(args) -> int:
+    """Price the canonical registry (``--trace`` CLI discipline: empty =
+    exit 2, broken builders = exit 1, skips are loud warnings)."""
+    from . import costs
+    reports, skipped, errors = costs.registry_reports(
+        args.patterns or None)
+    for s in skipped:
+        print("WARNING: builder skipped — %s\n  (off-chip runs need "
+              "shell-level XLA_FLAGS=--xla_force_host_platform_device_"
+              "count=8 set BEFORE jax initializes)" % s, file=sys.stderr)
+    for e in errors:
+        print("ERROR: %s" % e, file=sys.stderr)
+    if not reports:
+        print("programs: EMPTY registry%s — refusing to look green"
+              % (" for patterns %r" % (args.patterns,)
+                 if args.patterns else ""), file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps([r.as_dict() for r in reports], indent=1,
+                         sort_keys=True))
+    else:
+        print(costs.format_table(reports))
+    return 1 if errors else 0
 
 
 def cmd_serve(args) -> int:
@@ -234,7 +276,22 @@ def main(argv=None) -> int:
     r.add_argument("--allow-empty", action="store_true",
                    help="exit 0 even when the file holds no request "
                         "traces")
+    r.add_argument("--sli", action="store_true",
+                   help="add the per-finish-reason p50/p99 TTFT/TPOT "
+                        "rollup (table mode prints it after the "
+                        "per-request table; json mode adds an 'sli' key)")
     r.set_defaults(fn=cmd_trace_report)
+
+    g = sub.add_parser("programs",
+                       help="FLOPs/bytes/peak-HBM report over the "
+                            "trace-audit canonical program registry "
+                            "(XLA cost/memory analysis)")
+    g.add_argument("patterns", nargs="*",
+                   help="optional fnmatch filters on program names "
+                        "(e.g. 'serving/*')")
+    g.add_argument("--format", choices=("table", "json"),
+                   default="table")
+    g.set_defaults(fn=cmd_programs)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -140,4 +140,19 @@ CATALOG = {
         "counter", "XLA compilations per watched jit entry (the recompile "
         "watchdog warns/raises when a compile-once entry exceeds its "
         "budget)", labels=("entry",)),
+
+    # -- HBM ledger (observability.hbm — armed via PADDLE_TPU_HBM=1) --------
+    "hbm.live_bytes": _m(
+        "gauge", "live device bytes per device (summed jax.live_arrays(), "
+        "sampled at step/iteration boundaries by the armed ledger; a "
+        "sharded array's bytes split evenly across its devices)",
+        labels=("device",), unit="bytes"),
+    "hbm.kv_pool_bytes": _m(
+        "gauge", "summed KV-pool bytes of live serving engines (paged or "
+        "slotted, int8-aware: rows * kv_row_bytes() — codes + scales)",
+        unit="bytes"),
+    "hbm.restore_transient_bytes": _m(
+        "gauge", "host-side deserialized checkpoint tree held between "
+        "read and device placement (set for the restore's duration, "
+        "zero otherwise)", unit="bytes"),
 }

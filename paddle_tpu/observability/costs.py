@@ -1,0 +1,379 @@
+"""Compiled-program cost & memory reports — XLA's own numbers, surfaced.
+
+COVERAGE.md §2.3 declared the reference framework's op-level cost model a
+non-goal *because* "XLA cost analysis runs on the actual lowered program".
+This module cashes that claim: every lowered/compiled entry point can be
+priced with the compiler's own ``cost_analysis()`` (FLOPs, bytes accessed,
+transcendentals) and ``memory_analysis()`` (argument/output/temp/alias/
+generated-code bytes), and the canonical trace-audit registry
+(:mod:`paddle_tpu.analysis.trace.programs`) is priced wholesale:
+
+* :func:`registry_reports` — one :class:`ProgramReport` per canonical
+  program (the ``python -m paddle_tpu.observability programs`` CLI);
+* TPU506 (:mod:`paddle_tpu.analysis.trace.hbm_budget`) compares each
+  report's derived peak-HBM against a declared per-program budget — the
+  post-compile complement to TPU504's pre-compile VMEM estimate;
+* :func:`cost_block` — the schema'd ``cost`` block bench.py /
+  bench_decode.py attach to their JSON lines ({flops, hbm_bytes,
+  peak_bytes, mfu, bw_util}), with MFU / HBM-bandwidth-utilization
+  derived only when on-chip step timings exist (CPU lines carry the
+  static fields and ``null`` utilizations — the trajectory gate
+  validates their shape but never perf-gates them).
+
+Graceful degradation is the contract, not an accident: backends report
+different subsets (CPU's ``generated_code_size_in_bytes`` is 0, TPU adds
+real code/temp sizes; Pallas kernels price their interpret-mode lowering
+off-chip), ``cost_analysis()`` is list-shaped on jax <= 0.4.x (ONE compat
+shim here — :func:`cost_analysis_dict` — which ``hapi.flops`` also
+routes through), and a missing field is ``None``, never a guess.
+
+Derived peak: XLA 0.4.x exposes no single peak-memory scalar, so
+``peak_bytes = argument + output + temp - alias`` — the executable's
+whole-BUFFER high-water bound (donated/aliased buffers counted once;
+generated code is reported separately and excluded on purpose: code
+size varies wildly per backend and is not the data-buffer regression
+vector the TPU506 budgets gate).  The budgets are sized against this
+same derivation, so the gate is self-consistent.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "ProgramReport", "cost_analysis_dict", "memory_analysis_dict",
+    "report_from_compiled", "compile_program", "report_for_program",
+    "registry_reports", "peak_flops", "peak_hbm_bandwidth", "mfu",
+    "bw_util", "cost_block", "format_table",
+]
+
+# ---------------------------------------------------------------------------
+# per-part peak specs (published numbers, per chip); substring-matched
+# against jax's device_kind.  Overridable for new parts / corrected specs
+# via PADDLE_TPU_PEAK_FLOPS / PADDLE_TPU_PEAK_HBM_BW (floats, per chip).
+# ---------------------------------------------------------------------------
+
+#: bf16 peak FLOP/s per chip by device-kind substring (lowercase).
+PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12), ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+)
+
+#: HBM bandwidth bytes/s per chip by device-kind substring (lowercase).
+PEAK_HBM_BW_BY_KIND = (
+    ("v6e", 1640e9), ("v5p", 2765e9),
+    ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
+
+
+def _kind_lookup(table, kind: Optional[str]) -> Optional[float]:
+    if not kind:
+        return None
+    kind = kind.lower()
+    for sub, v in table:
+        if sub in kind:
+            return v
+    return None
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s of one chip (None off-chip / unknown part)."""
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    return _kind_lookup(PEAK_FLOPS_BY_KIND,
+                        device_kind or _device_kind())
+
+
+def peak_hbm_bandwidth(device_kind: Optional[str] = None
+                       ) -> Optional[float]:
+    """Peak HBM bytes/s of one chip (None off-chip / unknown part)."""
+    env = os.environ.get("PADDLE_TPU_PEAK_HBM_BW")
+    if env:
+        return float(env)
+    return _kind_lookup(PEAK_HBM_BW_BY_KIND,
+                        device_kind or _device_kind())
+
+
+def mfu(flops: Optional[float], step_seconds: Optional[float],
+        device_kind: Optional[str] = None) -> Optional[float]:
+    """Model FLOPs utilization of one compiled step: program FLOPs /
+    (step wall seconds * chip peak).  None whenever any input is
+    unknown — a fabricated 0.0 would enter the trajectory as a datum."""
+    peak = peak_flops(device_kind)
+    if not flops or not step_seconds or step_seconds <= 0 or not peak:
+        return None
+    return flops / (step_seconds * peak)
+
+
+def bw_util(hbm_bytes: Optional[float], step_seconds: Optional[float],
+            device_kind: Optional[str] = None) -> Optional[float]:
+    """HBM bandwidth utilization: program bytes-accessed / (step wall
+    seconds * chip peak bandwidth)."""
+    peak = peak_hbm_bandwidth(device_kind)
+    if not hbm_bytes or not step_seconds or step_seconds <= 0 or not peak:
+        return None
+    return hbm_bytes / (step_seconds * peak)
+
+
+# ---------------------------------------------------------------------------
+# extraction (THE compat shims — hapi.flops routes through these too)
+# ---------------------------------------------------------------------------
+
+def cost_analysis_dict(compiled, strict: bool = False) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` as ONE flat dict.
+
+    The single 0.4.x compat shim: jax <= 0.4.x returns a list with one
+    dict per device — identical replicas on a single-program compile, so
+    the first is taken; newer jax returns the dict directly.  A backend
+    that reports nothing yields ``{}``; a RAISING backend is swallowed
+    to ``{}`` only under ``strict=False`` (the ProgramReport path, which
+    carries available/note fields for the degradation) — ``strict=True``
+    propagates it for callers with no such channel (``hapi.flops``
+    must error, not answer 0, when the analysis itself fails)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        if strict:
+            raise
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+#: memory_analysis attributes extracted when present (per-backend subset)
+_MEMORY_FIELDS = (
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("alias_bytes", "alias_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+)
+
+
+def memory_analysis_dict(compiled) -> Dict[str, int]:
+    """``compiled.memory_analysis()`` as a plain dict of the fields this
+    backend reports (missing attributes are omitted, not guessed)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out: Dict[str, int] = {}
+    for name, attr in _MEMORY_FIELDS:
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[name] = int(v)
+    return out
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    """XLA's cost + memory view of one compiled program.
+
+    ``flops`` / ``bytes_accessed`` / ``transcendentals`` come from
+    ``cost_analysis()``; the ``*_bytes`` fields from
+    ``memory_analysis()``; ``peak_bytes`` is the derived whole-buffer
+    high-water bound (see module docstring).  ``available=False`` means
+    the program could not be compiled on this backend (``note`` says
+    why) — a row is still emitted so the CLI shows all 40+ canonical
+    programs, never a silently-shrunken registry."""
+
+    name: str
+    backend: str = ""
+    available: bool = True
+    note: str = ""
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    transcendentals: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _derive_peak(mem: Dict[str, int]) -> Optional[int]:
+    if not mem:
+        return None
+    have = [k for k in ("argument_bytes", "output_bytes", "temp_bytes")
+            if k in mem]
+    if not have:
+        return None
+    return (mem.get("argument_bytes", 0) + mem.get("output_bytes", 0)
+            + mem.get("temp_bytes", 0) - mem.get("alias_bytes", 0))
+
+
+def report_from_compiled(name: str, compiled, backend: Optional[str] = None,
+                         note: str = "") -> ProgramReport:
+    """Extract a :class:`ProgramReport` from a ``jax.stages.Compiled``."""
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = ""
+    ca = cost_analysis_dict(compiled)
+    mem = memory_analysis_dict(compiled)
+    return ProgramReport(
+        name=name, backend=backend, available=True, note=note,
+        flops=(float(ca["flops"]) if "flops" in ca else None),
+        bytes_accessed=(float(ca["bytes accessed"])
+                        if "bytes accessed" in ca else None),
+        transcendentals=(float(ca["transcendentals"])
+                         if "transcendentals" in ca else None),
+        argument_bytes=mem.get("argument_bytes"),
+        output_bytes=mem.get("output_bytes"),
+        temp_bytes=mem.get("temp_bytes"),
+        alias_bytes=mem.get("alias_bytes"),
+        generated_code_bytes=mem.get("generated_code_bytes"),
+        peak_bytes=_derive_peak(mem),
+    )
+
+
+# ---------------------------------------------------------------------------
+# canonical-registry pricing (the CLI + TPU506 share this)
+# ---------------------------------------------------------------------------
+
+def compile_program(program) -> Optional[Any]:
+    """The compiled executable of a :class:`TraceProgram` — from its
+    stored ``lowered`` entry, or its ``lower_thunk`` (Pallas kernel
+    programs, which the registry keeps at the jaxpr level and lowers on
+    demand).  None when the program carries neither.  Cached on the
+    program's meta so TPU506 and the CLI never compile twice in one
+    process; compile failures cache too (and re-raise) — retrying a
+    deterministic failure would just double the cost of a red run."""
+    cached = program.meta.get("_compiled")
+    if cached is not None:
+        if isinstance(cached, Exception):
+            raise cached
+        return cached
+    lowered = getattr(program, "lowered", None)
+    if lowered is None:
+        thunk = getattr(program, "lower_thunk", None)
+        if thunk is None:
+            return None
+        try:
+            lowered = thunk()
+        except Exception as e:
+            program.meta["_compiled"] = e
+            raise
+    try:
+        compiled = lowered.compile()
+    except Exception as e:
+        program.meta["_compiled"] = e
+        raise
+    program.meta["_compiled"] = compiled
+    return compiled
+
+
+def report_for_program(program) -> ProgramReport:
+    """Price one canonical program; degradation per backend is a row
+    with ``available=False`` and the reason, never a dropped row."""
+    try:
+        compiled = compile_program(program)
+    except Exception as e:
+        return ProgramReport(
+            name=program.name, backend=_backend_name(), available=False,
+            note="compile failed: %s: %s" % (type(e).__name__, e))
+    if compiled is None:
+        return ProgramReport(
+            name=program.name, backend=_backend_name(), available=False,
+            note="no lowered entry (jaxpr-only program)")
+    note = ""
+    if program.name.startswith("pallas/") and _backend_name() != "tpu":
+        note = "interpret-mode lowering (off-chip Pallas pricing)"
+    return report_from_compiled(program.name, compiled, note=note)
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return ""
+
+
+def registry_reports(patterns: Optional[Sequence[str]] = None
+                     ) -> Tuple[List[ProgramReport], List[str], List[str]]:
+    """One report per canonical-registry program (optionally
+    fnmatch-filtered).  Returns ``(reports, skipped, errors)`` with the
+    registry's own builder-skip/builder-error semantics — an empty
+    report list must never look green (the CLI exits 2)."""
+    from ..analysis.trace.programs import build_programs
+    programs, skipped, errors = build_programs(patterns)
+    return [report_for_program(p) for p in programs], skipped, errors
+
+
+# ---------------------------------------------------------------------------
+# the bench `cost` block
+# ---------------------------------------------------------------------------
+
+def cost_block(report: ProgramReport,
+               step_seconds: Optional[float] = None,
+               on_chip: bool = False,
+               device_kind: Optional[str] = None) -> Dict[str, Any]:
+    """The schema'd ``cost`` block for a bench JSON line.
+
+    Static fields always present (None when the backend reports no
+    number); ``mfu`` / ``bw_util`` derived only when ``on_chip`` and a
+    positive step timing exist — CPU smoke lines carry ``null`` there
+    and the trajectory gate validates shape only."""
+    use_t = step_seconds if on_chip else None
+    m = mfu(report.flops, use_t, device_kind)
+    b = bw_util(report.bytes_accessed, use_t, device_kind)
+    return {
+        "flops": report.flops,
+        "hbm_bytes": report.bytes_accessed,
+        "peak_bytes": report.peak_bytes,
+        "mfu": (round(m, 6) if m is not None else None),
+        "bw_util": (round(b, 6) if b is not None else None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI rendering
+# ---------------------------------------------------------------------------
+
+def _fmt_num(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return "%.2f%s" % (v / div, unit)
+    return "%.0f" % v
+
+
+def format_table(reports: Sequence[ProgramReport]) -> str:
+    """Human table for ``python -m paddle_tpu.observability programs``."""
+    lines = ["%-42s %10s %10s %10s %10s %10s  %s"
+             % ("program", "flops", "hbm_bytes", "peak", "args", "temps",
+                "note")]
+    for r in reports:
+        lines.append("%-42s %10s %10s %10s %10s %10s  %s"
+                     % (r.name, _fmt_num(r.flops),
+                        _fmt_num(r.bytes_accessed), _fmt_num(r.peak_bytes),
+                        _fmt_num(r.argument_bytes), _fmt_num(r.temp_bytes),
+                        r.note or ("" if r.available else "UNAVAILABLE")))
+    avail = sum(1 for r in reports if r.available)
+    lines.append("%d program(s), %d priced (backend: %s)"
+                 % (len(reports), avail, _backend_name()))
+    return "\n".join(lines)

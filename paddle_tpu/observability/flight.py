@@ -31,7 +31,10 @@ Each dump is one JSON file ``flight-<stamp>-<pid>-<seq>.json`` in
 ``PADDLE_TPU_FLIGHT_DIR`` (default: cwd) holding the trigger, the ring,
 the current metrics snapshot (catalog-valid by construction — it is the
 default registry's own), the pre-reset snapshot when noted, watchdog
-compile counts, and every live engine's state summary.
+compile counts, every live engine's state summary, and the HBM-ledger
+snapshot (:func:`~paddle_tpu.observability.hbm.ledger_state` — fresh
+per-device live bytes, top-arrays breakdown, KV-pool pricing: the "what
+held the memory" answer an OOM post-mortem needs).
 
 Disabled by default (``PADDLE_TPU_FLIGHT=0`` — registry discipline):
 ``record()`` is one module-global ``None`` check and dump triggers
@@ -128,6 +131,15 @@ class FlightRecorder:
             compiles = compile_counts()
         except Exception:
             compiles = {}
+        # the HBM ledger snapshot (ISSUE 11): fresh per-device live
+        # bytes + top-arrays breakdown + KV-pool pricing — "what held
+        # the memory" for an OOM post-mortem.  ledger_state() collects
+        # whether or not the ledger is armed and never raises.
+        try:
+            from . import hbm as _hbm
+            hbm_state = _hbm.ledger_state()
+        except Exception as e:
+            hbm_state = {"error": repr(e)}
         with self._lock:    # RLock: record() below re-enters it
             self.record("trigger", detail=dict(trigger))
             ring = list(self.ring)
@@ -144,6 +156,7 @@ class FlightRecorder:
             "metrics_pre_reset": pre,
             "compile_counts": compiles,
             "engines": self._engine_states(),
+            "hbm": hbm_state,
         }
         if path is None:
             global _SEQ

@@ -1,0 +1,269 @@
+"""The live HBM ledger — who holds device memory, sampled where it's safe.
+
+An OOM post-mortem (or a Perfetto timeline) needs three numbers the
+metrics registry didn't carry: **live device bytes** (what jax is
+actually holding, per device), **KV-pool bytes** (the serving engines'
+dominant allocation — paged or slotted, int8-aware via the engines' own
+``kv_row_bytes()`` accounting), and **checkpoint-restore transients**
+(the host-side deserialized tree that exists between read and device
+placement).  This module owns all three as catalog'd gauges:
+
+* ``hbm.live_bytes{device=}`` — ``sum(a.nbytes)`` over
+  ``jax.live_arrays()``, per device (a sharded array's bytes split
+  evenly across its devices — a per-shard approximation, documented);
+* ``hbm.kv_pool_bytes`` — summed ``kv_pool_bytes()`` over live
+  registered engines;
+* ``hbm.restore_transient_bytes`` — set for the duration of a
+  checkpoint restore, zero otherwise.
+
+**Sampling discipline** (the registry's): the ledger is OFF by default —
+:func:`maybe_sample` is one module-global ``None`` check
+(test-asserted), so the scheduler's per-iteration call and hapi fit's
+per-batch call cost nothing unless armed via ``PADDLE_TPU_HBM=1`` or
+:func:`enable`.  Samples run at **step/iteration boundaries on the
+host, never inside a trace**: ``jax.live_arrays()`` enumerates the
+runtime's buffers (meaningless under tracing) and the gauges' own
+``float()`` guard rejects tracers anyway.  ``PADDLE_TPU_HBM_EVERY=N``
+thins armed sampling to every N-th boundary.
+
+Every sample also appends **counter marks** ``(name, perf_ns, value)``
+to a bounded ring; :func:`paddle_tpu.observability.tracing.write_chrome`
+merges them as chrome-trace ``"C"`` events, so Perfetto shows HBM
+occupancy time-aligned with the request lanes and profiler spans.
+Flight-recorder dumps call :func:`ledger_state` (works armed or not —
+dump time is exactly when an unarmed process wants a fresh collection)
+to embed the per-device totals plus a **top-arrays breakdown**
+(aggregated by shape/dtype) — the "what held the memory" answer.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import registry as _registry
+
+__all__ = [
+    "HbmLedger", "enable", "disable", "active", "maybe_sample", "sample",
+    "register_engine", "note_restore", "clear_restore", "ledger_state",
+    "counter_marks", "MARKS_CAP", "TOP_ARRAYS",
+]
+
+#: bound on buffered chrome counter marks (drop-oldest past it)
+MARKS_CAP = 4096
+
+#: entries in the dump-time largest-live-arrays breakdown
+TOP_ARRAYS = 15
+
+#: live engines whose KV pools the ledger prices; module-level weakset so
+#: engines built before enable() are covered (flight-recorder pattern)
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+_ACTIVE: Optional["HbmLedger"] = None
+_LOCK = threading.Lock()
+
+
+def _live_per_device() -> Dict[str, float]:
+    """{device string: live bytes} over ``jax.live_arrays()``.  A sharded
+    array's bytes are split evenly across its devices (per-shard
+    approximation: jax reports the logical nbytes).  Deleted/torn arrays
+    are skipped — a mid-crash collection must not raise."""
+    import jax
+    per: Dict[str, float] = {}
+    for a in jax.live_arrays():
+        try:
+            devs = list(a.devices())
+            nb = float(a.nbytes)
+        except Exception:
+            continue
+        if not devs:
+            continue
+        share = nb / len(devs)
+        for d in devs:
+            key = str(d)
+            per[key] = per.get(key, 0.0) + share
+    return per
+
+
+def _top_arrays(n: int = TOP_ARRAYS) -> List[Dict[str, Any]]:
+    """The largest live allocations aggregated by (shape, dtype) — the
+    post-mortem's "what held the memory" table."""
+    import jax
+    agg: Dict[tuple, List[float]] = {}
+    for a in jax.live_arrays():
+        try:
+            key = (str(tuple(a.shape)), str(a.dtype))
+            nb = float(a.nbytes)
+        except Exception:
+            continue
+        ent = agg.setdefault(key, [0.0, 0])
+        ent[0] += nb
+        ent[1] += 1
+    rows = sorted(((b, c, k) for k, (b, c) in agg.items()), reverse=True)
+    return [{"shape": k[0], "dtype": k[1], "nbytes": int(b), "count": c}
+            for b, c, k in rows[:n]]
+
+
+def _kv_pool_total() -> float:
+    total = 0.0
+    for e in list(_ENGINES):
+        try:
+            total += float(e.kv_pool_bytes())
+        except Exception:
+            continue
+    return total
+
+
+class HbmLedger:
+    """The armed ledger: gauges + the chrome counter-mark ring."""
+
+    def __init__(self, sample_every: Optional[int] = None):
+        every = (sample_every if sample_every is not None
+                 else int(os.environ.get("PADDLE_TPU_HBM_EVERY", "1")))
+        self.sample_every = max(int(every), 1)
+        self._n = 0
+        self._lock = threading.Lock()
+        self._marks: deque = deque(maxlen=MARKS_CAP)
+        self._g_live = _registry.gauge("hbm.live_bytes", ("device",))
+        self._g_kv = _registry.gauge("hbm.kv_pool_bytes")
+        self._seen_devices: set = set()
+        self.last: Dict[str, Any] = {}
+
+    def _mark(self, name: str, ts_ns: int, value: float):
+        with self._lock:
+            self._marks.append((name, ts_ns, float(value)))
+
+    def sample(self, tag: str = "") -> Dict[str, Any]:
+        """One full collection: set the gauges, append counter marks,
+        remember the sample.  Host-side only — call at step/iteration
+        boundaries, never inside a trace."""
+        ts_ns = time.perf_counter_ns()
+        per = _live_per_device()
+        # a device that dropped out of the collection (its arrays were
+        # all deleted) must read 0, not its last value — a stale gauge
+        # would contradict ledger_state() in the exact OOM post-mortem
+        # this module exists for
+        for dev in self._seen_devices - set(per):
+            self._g_live.labels(device=dev).set(0.0)
+            self._mark("hbm.live_bytes{device=%s}" % dev, ts_ns, 0.0)
+        self._seen_devices = set(per)
+        for dev, nbytes in per.items():
+            self._g_live.labels(device=dev).set(nbytes)
+            self._mark("hbm.live_bytes{device=%s}" % dev, ts_ns, nbytes)
+        kv = _kv_pool_total()
+        self._g_kv.set(kv)
+        self._mark("hbm.kv_pool_bytes", ts_ns, kv)
+        self.last = {"ts_ns": ts_ns, "tag": tag, "devices": per,
+                     "kv_pool_bytes": kv,
+                     "live_bytes_total": sum(per.values())}
+        return self.last
+
+    def maybe_sample(self, tag: str = ""):
+        self._n += 1
+        if self._n % self.sample_every:
+            return None
+        return self.sample(tag)
+
+    def marks(self) -> List[tuple]:
+        with self._lock:
+            return list(self._marks)
+
+
+# ---------------------------------------------------------------------------
+# module-level API (what the instrumented subsystems call)
+# ---------------------------------------------------------------------------
+
+def enable(sample_every: Optional[int] = None) -> HbmLedger:
+    """Arm (or re-arm) the process-wide ledger."""
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = HbmLedger(sample_every=sample_every)
+        return _ACTIVE
+
+
+def disable():
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[HbmLedger]:
+    return _ACTIVE
+
+
+def maybe_sample(tag: str = ""):
+    """Per-boundary hook: ONE module-global ``None`` check when the
+    ledger is disarmed (the default) — the scheduler/fit hot loops pay
+    nothing (test-asserted, registry noop-identity discipline)."""
+    led = _ACTIVE
+    if led is None:
+        return None
+    return led.maybe_sample(tag)
+
+
+def sample(tag: str = ""):
+    led = _ACTIVE
+    if led is None:
+        return None
+    return led.sample(tag)
+
+
+def register_engine(engine):
+    """Track a serving engine (weakref) whose ``kv_pool_bytes()`` the
+    ledger prices.  Always cheap; engines register at construction."""
+    _ENGINES.add(engine)
+
+
+def counter_marks() -> List[tuple]:
+    """Buffered ``(name, perf_ns, value)`` marks for the chrome-trace
+    exporter's HBM counter lanes; [] while disarmed."""
+    led = _ACTIVE
+    return led.marks() if led is not None else []
+
+
+def note_restore(nbytes: int):
+    """Checkpoint restore began: record the transient host-side tree
+    size.  Sets the gauge regardless of arming (restores are cold path;
+    the gauge no-ops itself when metrics are off)."""
+    _registry.gauge("hbm.restore_transient_bytes").set(float(nbytes))
+    led = _ACTIVE
+    if led is not None:
+        led._mark("hbm.restore_transient_bytes",
+                  time.perf_counter_ns(), float(nbytes))
+
+
+def clear_restore():
+    _registry.gauge("hbm.restore_transient_bytes").set(0.0)
+    led = _ACTIVE
+    if led is not None:
+        led._mark("hbm.restore_transient_bytes",
+                  time.perf_counter_ns(), 0.0)
+
+
+def ledger_state(top_n: int = TOP_ARRAYS) -> Dict[str, Any]:
+    """JSON-ready ledger snapshot for flight dumps: a FRESH collection
+    (works armed or not — the dump moment is exactly when an unarmed
+    process wants one) plus the last periodic sample when armed.  Never
+    raises — a broken collection must not mask the fault being dumped."""
+    out: Dict[str, Any] = {"armed": _ACTIVE is not None}
+    try:
+        per = _live_per_device()
+        out["devices"] = per
+        out["live_bytes_total"] = sum(per.values())
+        out["top_arrays"] = _top_arrays(top_n)
+        out["kv_pool_bytes"] = _kv_pool_total()
+    except Exception as e:
+        out["error"] = repr(e)
+    led = _ACTIVE
+    if led is not None and led.last:
+        out["last_sample"] = dict(led.last)
+    return out
+
+
+# env opt-in: PADDLE_TPU_HBM=1 arms the ledger at import time (the
+# registry's env-knob discipline; PADDLE_TPU_HBM_EVERY thins sampling)
+if os.environ.get("PADDLE_TPU_HBM", "0") not in ("0", "", "false", "off"):
+    enable()

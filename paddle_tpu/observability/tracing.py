@@ -59,7 +59,7 @@ __all__ = [
     "Span", "NoopSpan", "Tracer", "NoopTracer",
     "NOOP_SPAN", "NOOP_TRACER",
     "default_tracer", "load_trace", "build_report", "format_report",
-    "chrome_events", "write_chrome",
+    "build_sli", "format_sli", "chrome_events", "write_chrome",
 ]
 
 #: default bound on buffered spans+events per tracer (drop-oldest past it)
@@ -503,6 +503,15 @@ def write_chrome(path: str, spans, events=(), include_profiler=True
                 "name": name, "ph": "C", "ts": ts / 1000.0, "pid": pid,
                 "cat": "metric", "args": {"value": value},
             } for name, ts, value in list(_prof._metric_marks))
+    # HBM-ledger counter lanes (ISSUE 11): occupancy samples share the
+    # perf_counter_ns clock, so Perfetto shows live/KV-pool bytes
+    # time-aligned with the request lanes.  [] while the ledger is
+    # disarmed; hbm imports no jax at module level (tracing discipline).
+    from . import hbm as _hbm
+    all_events.extend({
+        "name": name, "ph": "C", "ts": ts / 1000.0, "pid": os.getpid(),
+        "cat": "hbm", "args": {"value": value},
+    } for name, ts, value in _hbm.counter_marks())
     with open(path, "w") as f:
         json.dump({"traceEvents": all_events}, f)
     return path
@@ -689,6 +698,54 @@ def build_report(spans: List[dict], events: List[dict] = ()) -> dict:
         "preemptions": sum(r["preemptions"] for r in requests),
     }
     return {"requests": requests, "totals": totals}
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over exact per-request values (the SLI
+    table's statistic — not the registry histogram's bucketed
+    interpolation, which it is cross-checked against in tests)."""
+    if not sorted_vals:
+        return None
+    idx = max(int(-(-q * len(sorted_vals) // 1)) - 1, 0)
+    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+
+def build_sli(report: dict) -> Dict[str, Dict[str, Any]]:
+    """Per-finish-reason SLI rollup from a :func:`build_report` result:
+    request count plus p50/p99 TTFT and TPOT (seconds; ``None`` when no
+    request of that reason carries the statistic — a mid-prefill
+    eviction has no TTFT, PR-7 discipline)."""
+    by_reason: Dict[str, List[dict]] = {}
+    for r in report["requests"]:
+        by_reason.setdefault(str(r["finish_reason"] or "unknown"),
+                             []).append(r)
+    out: Dict[str, Dict[str, Any]] = {}
+    for reason, rs in sorted(by_reason.items()):
+        ttfts = sorted(r["ttft_s"] for r in rs if r["ttft_s"] is not None)
+        tpots = sorted(r["tpot_s"] for r in rs if r["decode_tokens"])
+        out[reason] = {
+            "requests": len(rs),
+            "ttft_p50_s": _pct(ttfts, 0.50), "ttft_p99_s": _pct(ttfts, 0.99),
+            "tpot_p50_s": _pct(tpots, 0.50), "tpot_p99_s": _pct(tpots, 0.99),
+        }
+    return out
+
+
+def format_sli(sli: Dict[str, Dict[str, Any]]) -> str:
+    """Human table for ``trace-report --sli``."""
+    lines = ["%-16s %8s %12s %12s %12s %12s"
+             % ("finish_reason", "requests", "ttft_p50_ms", "ttft_p99_ms",
+                "tpot_p50_ms", "tpot_p99_ms")]
+
+    def ms(v):
+        return "%.3f" % (1e3 * v) if v is not None else "-"
+
+    for reason, row in sli.items():
+        lines.append("%-16s %8d %12s %12s %12s %12s"
+                     % (reason, row["requests"], ms(row["ttft_p50_s"]),
+                        ms(row["ttft_p99_s"]), ms(row["tpot_p50_s"]),
+                        ms(row["tpot_p99_s"])))
+    return "\n".join(lines)
 
 
 def format_report(report: dict) -> str:

@@ -235,8 +235,11 @@ class DecodeEngine:
         else:
             self._init_slotted(cfg, min_bucket, donate)
         # black-box flight recorder: dumps collect this engine's state
-        # summary (weakref — registration never pins the engine)
+        # summary (weakref — registration never pins the engine); the
+        # HBM ledger prices this engine's KV pool the same way
         _flight.register_engine(self)
+        from ..observability import hbm as _hbm
+        _hbm.register_engine(self)
 
     def _kv_dtype_arg(self):
         return "int8" if self._quantized else None
@@ -949,6 +952,16 @@ class DecodeEngine:
             per_head = self._head_dim * self._cache_dtype.itemsize
         return self._layers * self._heads * per_head * 2
 
+    def kv_pool_bytes(self):
+        """Total bytes the KV pool holds resident — the HBM ledger's
+        ``hbm.kv_pool_bytes`` term.  Rows * ``kv_row_bytes()`` so the
+        int8 accounting (codes + scales) carries over: paged pools price
+        every page whether mapped or free (the allocation is static),
+        slotted pools the full ``slots * max_len`` buffer."""
+        rows = (self.num_pages * self.page_size if self.paged
+                else self.num_slots * self.max_len)
+        return rows * self.kv_row_bytes()
+
     def kv_bytes_per_token(self):
         """Observed decode KV-read accounting: bytes per generated token
         under (a) the paged true-length bound and (b) the slotted
@@ -1088,3 +1101,49 @@ class DecodeEngine:
     def cow_trace_args(self):
         return (self.cache.k, self.cache.v, *self._cache_scale_args(),
                 jnp.zeros((), jnp.int32), jnp.ones((), jnp.int32))
+
+    # -- cost reports (ISSUE 11) -------------------------------------------
+
+    def cost_reports(self, only=None):
+        """{watchdog entry name: ProgramReport} for every entry this
+        engine watches — XLA cost/memory analysis of the programs that
+        actually serve: audit trace args, production donation + x64
+        scope, and NO keep_unused (unlike the audit wrap — pricing
+        wants the pruned program that runs, not the alignment shim
+        TPU502 needs).  Lowers + compiles each entry once per call (the jit
+        dispatch cache is separate from the AOT path): cold path only —
+        benches call it AFTER the timed drain.  ``only`` (an iterable of
+        entry names) restricts pricing to those entries — a bench line
+        that reports one program must not pay 3 extra compiles."""
+        from ..observability import costs as _costs
+        entries = [("serving.decode", self._decode_fn,
+                    self._decode_donate_argnums, self.decode_trace_args())]
+        if self.paged:
+            entries.append(("serving.prefill_chunk", self._prefill_chunk_fn,
+                            self._prefill_chunk_donate_argnums,
+                            self.prefill_chunk_trace_args()))
+            entries.append(("serving.cow_copy", self._cow_fn,
+                            self._cow_donate_argnums, self.cow_trace_args()))
+            if self.spec_k:
+                entries.append(("serving.spec_verify", self._verify_fn,
+                                self._verify_donate_argnums,
+                                self.verify_trace_args()))
+        else:
+            entries.append(("serving.prefill", self._prefill_fn,
+                            self._prefill_donate_argnums,
+                            self.prefill_trace_args()))
+        if only is not None:
+            wanted = set(only)
+            unknown = wanted - {name for name, *_ in entries}
+            if unknown:
+                raise ValueError(
+                    "cost_reports(only=...) names entries this engine "
+                    "does not watch: %s" % sorted(unknown))
+            entries = [e for e in entries if e[0] in wanted]
+        out = {}
+        for name, fn, donate, args in entries:
+            with x64_scope(False):
+                compiled = jax.jit(fn, donate_argnums=donate) \
+                    .lower(*args).compile()
+            out[name] = _costs.report_from_compiled(name, compiled)
+        return out

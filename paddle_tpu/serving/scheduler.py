@@ -84,6 +84,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import hbm as _hbm
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
 from .engine import PagePoolExhausted
@@ -609,7 +610,13 @@ class ContinuousBatchingScheduler:
         Returns decode tokens produced (prefill first-tokens excluded)."""
         self.admit()
         self.prefill_once()
-        return self.decode_once()
+        n = self.decode_once()
+        # HBM ledger sample at the ITERATION boundary (host-side, after
+        # the batched step dispatched — never inside a trace).  One
+        # module-global None check while the ledger is disarmed, the
+        # default (tests assert the noop path).
+        _hbm.maybe_sample("serving.iteration")
+        return n
 
     def run(self) -> Dict[int, RequestResult]:
         """Drive to completion; returns {rid: RequestResult}.  Always

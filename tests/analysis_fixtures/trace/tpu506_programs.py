@@ -1,0 +1,29 @@
+"""TPU506 fixtures: a program whose compiled peak-HBM (derived
+argument+output+temp-alias bound) blows a deliberately tiny declared
+budget, a comfortably-fitting sibling as the negative, and a budgeted
+program with NO lowered entry — which must be a loud finding, not a
+skip (a budget whose program stopped being priceable would otherwise
+turn the gate silently green)."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.trace import TraceProgram
+
+
+def _fn(x):
+    return jnp.tanh(x @ x).sum()
+
+
+def build_programs():
+    x = jnp.zeros((64, 64), jnp.float32)     # >= 16 KiB of arguments
+    jaxpr = jax.make_jaxpr(_fn)(x)
+    return [
+        TraceProgram(name="fixture/tpu506_over_budget", jaxpr=jaxpr,
+                     lowered=jax.jit(_fn).lower(x),
+                     meta={"kind": "fixture", "hbm_budget": 1024}),
+        TraceProgram(name="fixture/tpu506_ok", jaxpr=jaxpr,
+                     lowered=jax.jit(_fn).lower(x),
+                     meta={"kind": "fixture", "hbm_budget": 1 << 24}),
+        TraceProgram(name="fixture/tpu506_unpriceable", jaxpr=jaxpr,
+                     meta={"kind": "fixture", "hbm_budget": 1024}),
+    ]

@@ -1058,6 +1058,43 @@ def test_flight_ring_is_bounded_and_engine_state_collected(tmp_path):
         flight.disable()
 
 
+def test_flight_dump_contains_hbm_ledger_snapshot(tmp_path):
+    """ISSUE-11 acceptance: a crash dump embeds the HBM ledger — fresh
+    per-device live bytes, the top-arrays breakdown ("what held the
+    memory"), and the registered engine's KV-pool pricing — whether or
+    not periodic sampling was armed; when armed, the last periodic
+    sample rides along too."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import flight, hbm
+    from paddle_tpu.serving.engine import DecodeEngine
+
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    engine = DecodeEngine(GPTForCausalLM(cfg), num_slots=2, max_len=64,
+                          page_size=8, seed=0)
+    flight.enable(dir=str(tmp_path))
+    try:
+        # unarmed ledger: the dump still collects fresh state
+        path = flight.crash_dump({"kind": "manual"})
+        doc = _load_dump(path)
+        assert doc["hbm"]["armed"] is False
+        assert doc["hbm"]["devices"], "no per-device live bytes in dump"
+        assert doc["hbm"]["live_bytes_total"] > 0
+        assert doc["hbm"]["top_arrays"], "no what-held-the-memory table"
+        top = doc["hbm"]["top_arrays"][0]
+        assert top["nbytes"] > 0 and top["shape"] and top["dtype"]
+        assert doc["hbm"]["kv_pool_bytes"] >= engine.kv_pool_bytes()
+        # armed ledger: the last periodic sample is preserved in dumps
+        hbm.enable()
+        hbm.sample("pre-crash")
+        doc2 = _load_dump(flight.crash_dump({"kind": "manual"}))
+        assert doc2["hbm"]["armed"] is True
+        assert doc2["hbm"]["last_sample"]["tag"] == "pre-crash"
+    finally:
+        hbm.disable()
+        flight.disable()
+
+
 def test_flight_dump_deferred_out_of_signal_frame(tmp_path):
     """A REAL signal's handler must not dump synchronously (it may have
     interrupted a frame holding the flight/metric locks) — the dump is

@@ -42,7 +42,7 @@ def fixture_report():
 
 def test_rule_catalogue():
     assert set(TRACE_RULES) == {"TPU501", "TPU502", "TPU503", "TPU504",
-                                "TPU505"}
+                                "TPU505", "TPU506"}
 
 
 def test_fixture_matrix(fixture_report):
@@ -63,6 +63,11 @@ def test_fixture_matrix(fixture_report):
     assert by["fixture/tpu503_undeclared_axis"] == [
         ("TPU503", "shard_map.0")]
     assert by["fixture/tpu504_oversized"] == [("TPU504", "pallas_call.0")]
+    assert by["fixture/tpu506_over_budget"] == [
+        ("TPU506", "memory/peak_bytes")]
+    # a budgeted program that cannot be priced is LOUD, never a skip
+    assert by["fixture/tpu506_unpriceable"] == [
+        ("TPU506", "memory/peak_bytes")]
     dirty = sorted(by["fixture/tpu505_dirty"])
     assert ("TPU505", "debug_callback.0") in dirty
     assert ("TPU505", "dot_general.0") in dirty     # dead matmul
@@ -75,7 +80,8 @@ def test_fixture_matrix(fixture_report):
     # negatives are silent
     for neg in ("fixture/tpu501_ok", "fixture/tpu501_unscoped",
                 "fixture/tpu502_ok", "fixture/tpu503_ok",
-                "fixture/tpu504_ok", "fixture/tpu505_ok"):
+                "fixture/tpu504_ok", "fixture/tpu505_ok",
+                "fixture/tpu506_ok"):
         assert neg not in by, by.get(neg)
 
 
@@ -85,6 +91,7 @@ def test_finding_messages_carry_rationale(fixture_report):
     assert "HBM" in msgs["TPU502"]
     assert "deadlock" in msgs["TPU503"] or "axis" in msgs["TPU503"]
     assert "VMEM" in msgs["TPU504"]
+    assert "budget" in msgs["TPU506"]
 
 
 def test_trace_baseline_roundtrip(tmp_path):

@@ -538,6 +538,71 @@ def test_trace_report_cli_round_trip(tmp_path, capsys):
                 str(tmp_path / "missing.jsonl")]) == 2
 
 
+def test_sli_rollup_cross_checks_histograms(tmp_path, capsys):
+    """ISSUE-11 satellite: `trace-report --sli` — the per-finish-reason
+    p50/p99 TTFT/TPOT rollup from an exported trace file, cross-checked
+    against the PR-6 histograms on the same run: counts match the
+    finished_requests counter exactly, and the exact-value percentiles
+    agree with the registry histograms' bucketed ones within the
+    buckets' documented resolution."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability.tracing import build_sli, format_sli
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+    obs.default_registry().reset()
+    tr = Tracer()
+    eng = _engine(tracer=tr)
+    sched = ContinuousBatchingScheduler(eng, tracer=tr)
+    rng = np.random.default_rng(3)
+    for i in range(4):
+        sched.submit(Request(prompt=rng.integers(0, 257, (6 + 2 * i,)),
+                             max_new_tokens=3 + i, temperature=0.0))
+    results = sched.run()
+    rep = build_report(tr.spans(), tr.instants())
+    sli = build_sli(rep)
+
+    assert set(sli) == {"length"}
+    row = sli["length"]
+    assert row["requests"] == 4
+    # counts agree with the per-reason counter AND the histograms
+    c = obs.counter("serving.finished_requests", ("reason",))
+    assert c.labels(reason="length").value == 4
+    h_ttft = obs.histogram("serving.ttft_seconds")
+    h_tpot = obs.histogram("serving.tpot_seconds")
+    assert h_ttft.count == 4 and h_tpot.count == 4
+    # exact-value percentiles vs the RequestResults...
+    exact = sorted(r.ttft for r in results.values())
+    assert row["ttft_p50_s"] == pytest.approx(exact[1], abs=0.05)
+    assert row["ttft_p99_s"] == pytest.approx(exact[-1], abs=0.05)
+    # ...and vs the bucketed histogram readout (12/decade log buckets
+    # => ~21% max relative error, the registry's own documented bound)
+    assert h_ttft.percentile(0.50) == pytest.approx(row["ttft_p50_s"],
+                                                    rel=0.30)
+    assert h_ttft.percentile(0.99) == pytest.approx(row["ttft_p99_s"],
+                                                    rel=0.30)
+    assert h_tpot.percentile(0.50) == pytest.approx(row["tpot_p50_s"],
+                                                    rel=0.30)
+    assert row["tpot_p50_s"] <= row["tpot_p99_s"]
+
+    # the SLI table renders every column
+    table = format_sli(sli)
+    assert "finish_reason" in table and "length" in table
+
+    # CLI round trip: --sli adds the rollup to both formats
+    from paddle_tpu.observability.__main__ import main as cli
+    p = str(tmp_path / "trace.jsonl")
+    tr.export_jsonl(p)
+    assert cli(["trace-report", "--file", p, "--sli",
+                "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["sli"]["length"]["requests"] == 4
+    assert doc["sli"]["length"]["ttft_p50_s"] == pytest.approx(
+        row["ttft_p50_s"])
+    assert cli(["trace-report", "--file", p, "--sli"]) == 0
+    out = capsys.readouterr().out
+    assert "finish_reason" in out and "ttft_p99_ms" in out
+
+
 def test_trace_report_cli_disconnected_exits_1(tmp_path, capsys):
     p = str(tmp_path / "bad.jsonl")
     with open(p, "w") as f:

@@ -10,15 +10,26 @@ Two input shapes:
 
 The line schema is the contract bench.py / bench_decode.py print:
 required ``metric``/``value``/``unit``; optional ``compile_counts`` (a
-{entry: count>=1} int map) and the ISSUE-6 ``metrics`` block::
+{entry: count>=1} int map), the ISSUE-6 ``metrics`` block::
 
     "metrics": {
       "histograms": {"<name>": {"p50_ms", "p95_ms", "p99_ms", "count"}},
       "compile_counts": {"<watchdog entry>": int}
     }
 
+and the ISSUE-11 ``cost`` block (XLA cost/memory analysis of the
+compiled step the bench timed)::
+
+    "cost": {"flops": N|null, "hbm_bytes": N|null, "peak_bytes": N|null,
+             "mfu": f|null, "bw_util": f|null}
+
+— all five keys required when the block is present; ``mfu``/``bw_util``
+are null off-chip (CPU smoke validates SHAPE only, per-backend
+degradation is the costs.py contract).  ``--expect-cost`` makes the
+block mandatory (the CI bench-smoke gate).
+
 Old trajectory files (pre-metrics-block, BENCH_r01..r05) validate clean:
-the block is optional, but WHEN present it must be well-formed
+each block is optional, but WHEN present it must be well-formed
 (percentiles ordered p50<=p95<=p99, non-negative counts).
 
 ``--expect-compile-once ENTRY`` additionally requires the watchdog's
@@ -43,7 +54,12 @@ gates run over each series —
   interleaves quantized/speculative lines in one trajectory), a >3%
   drop in ``value`` fails.  CPU entries never perf-gate (smoke
   numbers), so the gate arms itself automatically the first session
-  that records chip numbers.
+  that records chip numbers;
+* **cost cursors (ISSUE 11)**: over the same like-for-like on-chip
+  pairs, a >3% ``cost.mfu`` drop or >5% ``cost.peak_bytes`` growth
+  fails — a perf PR that holds tokens/s by burning memory (or that
+  silently halves utilization behind a bigger batch) no longer sails
+  through.  CPU entries contribute shape validation only.
 
 ``--trajectory --write OUT`` additionally emits the assembled series as
 one JSON document (the trajectory file CI archives).
@@ -102,6 +118,31 @@ def validate_compile_counts(cc: Any, path: str, where: str):
                  "least once" % (where, entry, count))
 
 
+#: the ISSUE-11 cost block: all five keys required when present; static
+#: fields may be null (a backend that reports no number never fabricates
+#: one) and utilizations are null off-chip by contract.
+_COST_KEYS = ("flops", "hbm_bytes", "peak_bytes", "mfu", "bw_util")
+
+
+def validate_cost_block(c: Any, path: str):
+    _require(isinstance(c, dict), path, "'cost' must be an object")
+    for k in _COST_KEYS:
+        _require(k in c, path, "cost block missing %r" % k)
+        v = c[k]
+        if v is None:
+            continue
+        _require(_is_num(v), path,
+                 "cost[%r] must be a number or null, got %r" % (k, v))
+        _require(v >= 0, path, "cost[%r] is negative" % k)
+    for k in ("mfu", "bw_util"):
+        if c[k] is not None:
+            # a utilization over 2.0 means the peak table or the timing
+            # is wrong — reject the line rather than archive nonsense
+            _require(c[k] <= 2.0, path,
+                     "cost[%r] = %r is not a plausible utilization"
+                     % (k, c[k]))
+
+
 def validate_trace_block(t: Any, path: str):
     """The ISSUE-9 optional ``trace`` block (bench_decode --trace-file):
     span counts per request plus the exported file path.  Optional —
@@ -128,7 +169,8 @@ def validate_trace_block(t: Any, path: str):
 
 
 def validate_line(doc: Any, path: str,
-                  expect_compile_once: List[str] = ()):
+                  expect_compile_once: List[str] = (),
+                  expect_cost: bool = False):
     _require(isinstance(doc, dict), path, "bench line must be a JSON object")
     for k, t in (("metric", str), ("unit", str)):
         _require(isinstance(doc.get(k), t), path,
@@ -137,6 +179,11 @@ def validate_line(doc: Any, path: str,
     if "vs_baseline" in doc:
         _require(_is_num(doc["vs_baseline"]), path,
                  "'vs_baseline' must be a number")
+    if expect_cost:
+        _require("cost" in doc, path,
+                 "--expect-cost: the bench line carries no 'cost' block")
+    if "cost" in doc:
+        validate_cost_block(doc["cost"], path)
     if "trace" in doc:
         validate_trace_block(doc["trace"], path)
     if "compile_counts" in doc:
@@ -226,6 +273,8 @@ _COMPILE_ONCE = {
 }
 
 REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
+MFU_TOLERANCE = 0.03            # >3% on-chip cost.mfu drop fails
+PEAK_HBM_TOLERANCE = 0.05       # >5% on-chip cost.peak_bytes growth fails
 
 
 def check_trajectory(paths: List[str], write: str = None) -> List[str]:
@@ -257,6 +306,8 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "spec": line.get("spec"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
                 "compile_counts", line.get("compile_counts")),
+            "cost": (line.get("cost")
+                     if isinstance(line.get("cost"), dict) else None),
         }
         series.setdefault(entry["metric"], []).append(entry)
 
@@ -282,6 +333,15 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
     # anchor, leaving the gate silently inert (regression-tested).
     for metric, entries in series.items():
         prev_by_key = {}
+        # PER-METRIC cost anchors: the last like-for-like entry whose
+        # cost block carried THAT number.  One shared anchor would let a
+        # round with a partial block (mfu null but peak_bytes present —
+        # a real on-chip case when the part is missing from the peak
+        # table) displace the MFU anchor and silently disarm that gate
+        # across the gap; a fully cost-less round (older bench checkout)
+        # must not displace either.
+        prev_mfu_by_key = {}
+        prev_peak_by_key = {}
         for e in entries:
             if e["backend"] != "tpu":
                 continue
@@ -298,6 +358,38 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                         % (e["file"], metric, 100 * drop, prev["file"],
                            prev["value"], e["value"],
                            100 * REGRESSION_TOLERANCE))
+            # gate 3 — cost cursors (ISSUE 11): like-for-like on-chip
+            # pairs also gate MFU (>3% drop) and peak HBM (>5% growth),
+            # each against ITS OWN last-carrying anchor.
+            ec = e["cost"] or {}
+            prev_m = prev_mfu_by_key.get(key)
+            pm = ((prev_m or {}).get("cost") or {})
+            if (prev_m is not None and _is_num(ec.get("mfu"))
+                    and _is_num(pm.get("mfu")) and pm["mfu"] > 0):
+                mfu_drop = 1.0 - ec["mfu"] / pm["mfu"]
+                if mfu_drop > MFU_TOLERANCE:
+                    failures.append(
+                        "%s: on-chip cost regression — MFU fell %.1f%% "
+                        "vs %s (%.4f -> %.4f; tolerance %.0f%%)"
+                        % (e["file"], 100 * mfu_drop, prev_m["file"],
+                           pm["mfu"], ec["mfu"], 100 * MFU_TOLERANCE))
+            prev_p = prev_peak_by_key.get(key)
+            pp = ((prev_p or {}).get("cost") or {})
+            if (prev_p is not None and _is_num(ec.get("peak_bytes"))
+                    and _is_num(pp.get("peak_bytes"))
+                    and pp["peak_bytes"] > 0):
+                growth = ec["peak_bytes"] / pp["peak_bytes"] - 1.0
+                if growth > PEAK_HBM_TOLERANCE:
+                    failures.append(
+                        "%s: on-chip cost regression — peak HBM grew "
+                        "%.1f%% vs %s (%d -> %d bytes; tolerance %.0f%%)"
+                        % (e["file"], 100 * growth, prev_p["file"],
+                           pp["peak_bytes"], ec["peak_bytes"],
+                           100 * PEAK_HBM_TOLERANCE))
+            if _is_num(ec.get("mfu")):
+                prev_mfu_by_key[key] = e
+            if _is_num(ec.get("peak_bytes")):
+                prev_peak_by_key[key] = e
             prev_by_key[key] = e
 
     if write and not failures:
@@ -325,6 +417,10 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-compile-once", action="append", default=[],
                     metavar="ENTRY",
                     help="require metrics.compile_counts[ENTRY] == 1")
+    ap.add_argument("--expect-cost", action="store_true",
+                    help="require the ISSUE-11 'cost' block on the line "
+                         "(the CI bench-smoke gate; shape-validated on "
+                         "every backend)")
     ap.add_argument("--trajectory", action="store_true",
                     help="series mode: validate the ordered BENCH_r*/"
                          "BENCH_decode_* trajectory, assert compile "
@@ -354,7 +450,8 @@ def main(argv=None) -> int:
                     raise SchemaError("<stdin>: no input line")
                 raw = lines[-1]
             validate_line(json.loads(raw), "<line>",
-                          args.expect_compile_once)
+                          args.expect_compile_once,
+                          expect_cost=args.expect_cost)
             print("ok: <line>")
     except SchemaError as e:
         failures.append(str(e))
