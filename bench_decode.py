@@ -43,6 +43,15 @@ below runs as one matrix, one JSON line each):
   amortized over every committed token, so `kv_bytes_per_token.paged`
   drops with the accept rate — the second multiplicative lever on the
   same bandwidth wall.
+* `--tp N` (comma list, ISSUE 12) — tensor-parallel sharded decode:
+  the paged KV pool partitioned over heads on an ('mp',) mesh of N
+  devices, one sharded program per entry.  `kv_bytes_per_token` is
+  reported PER CHIP, so the tp=N line's paged bound is ~1/N of the
+  tp=1 line — the acceptance ratio; the lever that ADDS hardware
+  instead of squeezing one chip.  Needs N devices (CPU: set
+  XLA_FLAGS=--xla_force_host_platform_device_count).  `tp` is a
+  trajectory cursor field: tp=1 and tp=2 series never gate against
+  each other.
 
 On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
 On CPU: a tiny head_dim-64 config (`tiny_d64`), so the bench always
@@ -61,7 +70,7 @@ import time
 import numpy as np
 
 
-def run_config(paged: bool, kv_dtype: str, spec: int,
+def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
                trace_file: str = None):
     import jax
 
@@ -73,6 +82,13 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
                                               Request)
 
     on_tpu = jax.default_backend() == "tpu"
+    if tp > len(jax.devices()):
+        # LOUD: a silent skip would hide a missing XLA_FLAGS in CI and
+        # quietly drop a matrix line the schema gate expects
+        raise SystemExit(
+            "bench_decode: --tp %d needs %d devices, have %d (CPU: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count)"
+            % (tp, tp, len(jax.devices())))
     paddle.seed(0)
 
     if on_tpu:
@@ -108,7 +124,7 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
                           seed=0, paged=paged, page_size=page_size,
                           kv_dtype=("int8" if kv_dtype == "int8"
                                     else None),
-                          spec_k=spec, tracer=tracer)
+                          spec_k=spec, tracer=tracer, tp=tp)
     rng = np.random.default_rng(0)
     # one shared "system prompt" a third of the requests reuse — the
     # prefix-sharing path must be ON the timed path, not a dead feature
@@ -183,14 +199,17 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
         "wall_s": round(dt, 3),
         "cache_layout": "paged" if paged else "slotted",
         # trajectory cursor keys (bench_schema gates like-for-like
-        # series): the quantization and speculation axes
+        # series): the quantization, speculation and tensor-parallel axes
         "kv_dtype": kv_dtype,
         "spec": spec,
-        # the ISSUE-7/8 acceptance line: decode KV bytes read per
-        # generated token — `paged` scales with TRUE lengths (mapped
-        # pages, amortized over every spec-committed token), `flat` is
-        # the slotted slots*max_len bound; int8 halves the per-row cost
-        # (codes + scales accounted)
+        "tp": tp,
+        # the ISSUE-7/8/12 acceptance line: decode KV bytes read per
+        # generated token PER CHIP — `paged` scales with TRUE lengths
+        # (mapped pages, amortized over every spec-committed token),
+        # `flat` is the slotted slots*max_len bound; int8 halves the
+        # per-row cost (codes + scales accounted) and tensor parallelism
+        # divides the per-chip row by tp (the tp=N line reads ~1/N of
+        # the tp=1 bound)
         "kv_bytes_per_token": {k: round(v, 1) for k, v in kv.items()},
         "prefix_hit_tokens": prefix_hit_tokens,
         # compile accounting now comes from the recompile watchdog (which
@@ -230,7 +249,7 @@ def run_config(paged: bool, kv_dtype: str, spec: int,
             "backend": jax.default_backend(),
             "num_slots": num_slots, "max_len": max_len,
             "prompt_len": prompt_len, "max_new_tokens": max_new,
-            "requests": requests,
+            "requests": requests, "tp": tp,
             **({"page_size": engine.page_size,
                 "num_pages": engine.num_pages,
                 "prefill_chunk": engine.prefill_chunk} if paged else {}),
@@ -282,6 +301,11 @@ def main(argv=None):
     ap.add_argument("--spec", default="off",
                     help="comma list of off|<k>: speculative draft "
                          "length per iteration (paged only)")
+    ap.add_argument("--tp", default="1",
+                    help="comma list of tensor-parallel degrees (paged "
+                         "only; tp devices required — CPU: set "
+                         "XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count)")
     ap.add_argument("--trace-file", default=None, metavar="PATH",
                     help="export a request-scoped span trace (JSONL) of "
                          "the timed drain; feed it to `python -m "
@@ -309,21 +333,41 @@ def main(argv=None):
         else:
             ap.error("--spec values must be 'off' or a positive draft "
                      "length, got %r" % tok)
+    tps = []
+    for tok in str(args.tp).split(","):
+        tok = tok.strip()
+        if tok.isdigit() and int(tok) >= 1:
+            tps.append(int(tok))
+        else:
+            ap.error("--tp values must be positive integers, got %r"
+                     % tok)
+    if max(tps) > 1:
+        # fail BEFORE any config runs: a mid-matrix death would burn the
+        # earlier configs' warm+timed drains and emit a partial series
+        import jax
+        if max(tps) > len(jax.devices()):
+            ap.error("--tp %d needs %d devices, have %d (CPU: set "
+                     "XLA_FLAGS=--xla_force_host_platform_device_count)"
+                     % (max(tps), max(tps), len(jax.devices())))
 
-    configs = [(paged, kv_dtype, spec)
+    configs = [(paged, kv_dtype, spec, tp)
                for paged in layouts
                for kv_dtype in kv_dtypes
                for spec in specs
-               if not (spec and not paged)]   # speculation is paged-only
+               for tp in tps
+               # speculation AND tensor parallelism are paged-only
+               if not ((spec or tp > 1) and not paged)]
     if not configs:
         # e.g. --slotted --spec 4: silently emitting ZERO lines would
         # make a CI pipe fail later with an opaque empty-stdin error
         ap.error("no runnable configuration: speculative decode "
-                 "(--spec > 0) needs the paged layout")
-    for paged, kv_dtype, spec in configs:
+                 "(--spec > 0) and tensor parallelism (--tp > 1) need "
+                 "the paged layout")
+    for paged, kv_dtype, spec, tp in configs:
         # run_config resets the registry and resyncs the watchdog after
         # its own warmup drain, so no inter-config state scrub is needed
-        run_config(paged, kv_dtype, spec, trace_file=args.trace_file)
+        run_config(paged, kv_dtype, spec, tp=tp,
+                   trace_file=args.trace_file)
 
 
 if __name__ == "__main__":

@@ -25,6 +25,25 @@ Scoping: collectives inside ``while`` bodies are checked for axis
 membership but not trip-count uniformity (data-dependent trip counts are
 undecidable statically); positional (int) axes are hardware-anonymous and
 skipped.
+
+**SPMD-sharded entries (ISSUE 12).**  GSPMD programs (jit with in/out
+shardings — the serving engine's tensor-parallel twins) carry no
+collective *primitives* in their jaxpr: XLA's partitioner inserts the
+collectives at compile time, which would make the three jaxpr checks
+vacuously green on exactly the programs that go multi-chip first.
+Programs whose meta declares ``spmd_sharded: True`` therefore get two
+extra mechanical checks:
+
+* the lowered module's ``mhlo.num_partitions`` must equal the declared
+  mesh's device product (a registered sharded entry whose jit shardings
+  quietly used a different mesh is a trace/deployment mismatch);
+* the COMPILED (post-partitioning) HLO must contain collective
+  instructions at all — a "sharded" entry whose partitioned program
+  moves no data was silently replicated, the sharding never happened —
+  and every collective's ``replica_groups`` must be well-formed over the
+  partition count (ids in range, disjoint uniform groups whose size
+  divides the mesh product): a malformed group is the GSPMD-era
+  equivalent of a collective over an undeclared axis.
 """
 from __future__ import annotations
 
@@ -87,11 +106,120 @@ class CollectiveOrderPass(TracePass):
                    "sizes; ppermute permutations in range")
 
     def check(self, program: TraceProgram) -> Iterable[Finding]:
-        if program.jaxpr is None:
-            return
         declared = dict(program.meta.get("mesh_axes", {}) or {})
-        jaxpr = getattr(program.jaxpr, "jaxpr", program.jaxpr)
-        yield from self._walk(program, jaxpr, declared, OpPathCounter())
+        if program.jaxpr is not None:
+            jaxpr = getattr(program.jaxpr, "jaxpr", program.jaxpr)
+            yield from self._walk(program, jaxpr, declared,
+                                  OpPathCounter())
+        if program.meta.get("spmd_sharded"):
+            yield from self._check_spmd(program, declared)
+
+    # -- GSPMD-sharded entries (ISSUE 12) ----------------------------------
+
+    #: stable pseudo-paths for the whole-program SPMD findings
+    SPMD_SYMBOL = "spmd/num_partitions"
+    SPMD_COLL_SYMBOL = "spmd/partitioned_collectives"
+
+    def _check_spmd(self, program, declared) -> Iterable[Finding]:
+        import re
+        n = 1
+        for v in declared.values():
+            n *= int(v)
+        text = program.lowered_text or ""
+        m = re.search(r"mhlo\.num_partitions\s*=\s*(\d+)", text)
+        got = int(m.group(1)) if m else None
+        if got != n:
+            yield self.finding(
+                program, self.SPMD_SYMBOL,
+                "sharded entry lowered with num_partitions=%s but the "
+                "declared mesh (%s) has %d devices — the registered "
+                "shardings and the declared topology disagree"
+                % (got, declared or "{}", n))
+            return
+        if n <= 1:
+            return
+        # the partitioned program: compile off the stored lowered entry
+        # (cached on program.meta — TPU506 and the cost CLI share it)
+        from ...observability import costs as _costs
+        try:
+            compiled = _costs.compile_program(program)
+        except Exception as e:
+            yield self.finding(
+                program, self.SPMD_COLL_SYMBOL,
+                "sharded entry failed to compile for the partitioned-"
+                "collective audit: %s: %s — an unverifiable sharded "
+                "program must not look green" % (type(e).__name__, e))
+            return
+        try:
+            hlo = compiled.as_text() if compiled is not None else None
+        except Exception:
+            hlo = None
+        # ONE collective-instruction scan for the whole repo: the same
+        # op list / async-pair rules price the serving.collective_bytes
+        # counter — a second copy here would drift
+        stats = (None if compiled is None
+                 else _costs.collective_stats(compiled))
+        if stats is None or not isinstance(hlo, str) or not hlo:
+            yield self.finding(
+                program, self.SPMD_COLL_SYMBOL,
+                "backend exposes no compiled HLO text — the partitioned-"
+                "collective audit cannot run on a program that DECLARES "
+                "spmd_sharded, and must not look green")
+            return
+        if stats["ops"] == 0:
+            yield self.finding(
+                program, self.SPMD_COLL_SYMBOL,
+                "declared sharded over %d devices but the partitioned "
+                "program contains NO collective instructions — the "
+                "sharding silently never materialized (a head-partitioned "
+                "decode must at least psum its row-parallel projections)"
+                % n)
+            return
+        for groups in self._replica_groups(hlo):
+            flat = [d for g in groups for d in g]
+            sizes = {len(g) for g in groups}
+            bad = None
+            if any(d < 0 or d >= n for d in flat):
+                bad = "device ids outside [0, %d)" % n
+            elif len(set(flat)) != len(flat):
+                bad = "overlapping groups"
+            elif len(sizes) != 1:
+                bad = "non-uniform group sizes %s" % sorted(sizes)
+            elif n % next(iter(sizes)):
+                bad = ("group size %d does not divide the mesh's %d "
+                       "devices" % (next(iter(sizes)), n))
+            if bad:
+                yield self.finding(
+                    program, self.SPMD_COLL_SYMBOL,
+                    "malformed replica_groups %s in the partitioned "
+                    "program: %s" % (groups, bad))
+
+    @staticmethod
+    def _replica_groups(hlo: str):
+        """Parse every replica_groups attribute in an HLO text — both the
+        literal ``{{0,1},{2,3}}`` form and the iota form
+        ``[G,S]<=[N...]`` (reshape of arange over the partition ids);
+        iota forms with a transpose are skipped rather than guessed."""
+        import re
+        out = []
+        for m in re.finditer(r"replica_groups=\{(\{[^}]*\}"
+                             r"(?:,\{[^}]*\})*)\}", hlo):
+            groups = []
+            for g in re.findall(r"\{([^}]*)\}", m.group(1)):
+                groups.append([int(x) for x in g.split(",") if x.strip()])
+            out.append(groups)
+        for m in re.finditer(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]",
+                             hlo):
+            g, s = int(m.group(1)), int(m.group(2))
+            dims = [int(x) for x in m.group(3).split(",")]
+            total = 1
+            for d in dims:
+                total *= d
+            if total != g * s or len(dims) != 1:
+                continue    # transposed iota: don't guess
+            ids = list(range(total))
+            out.append([ids[i * s:(i + 1) * s] for i in range(g)])
+        return out
 
     def _walk(self, program, jaxpr, declared, counter) -> Iterable[Finding]:
         for eqn in jaxpr.eqns:

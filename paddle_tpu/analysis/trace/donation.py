@@ -32,9 +32,16 @@ _MAIN_RE = re.compile(
     r"func\.func\s+(?:public\s+)?@main\s*\((?P<args>.*?)\)\s*->"
     r"(?P<results>[^\n]*)",
     re.S)
+#: attrs are brace-delimited but may CONTAIN braces inside quoted
+#: strings — a sharded entry's arguments carry
+#: ``mhlo.sharding = "{devices=[...]<=[N]}"`` ahead of
+#: ``tf.aliasing_output`` (ISSUE 12), and a naive ``[^}]*`` stops at the
+#: quoted ``}`` and silently drops every attribute after the sharding,
+#: reporting materialized donations as misses on exactly the sharded
+#: entries the audit was extended to cover
 _ARG_RE = re.compile(
     r"%arg(?P<idx>\d+):\s*(?P<type>(?:tensor|!stablehlo\.token)[^{,)]*)"
-    r"(?:\{(?P<attrs>[^}]*)\})?")
+    r"(?:\{(?P<attrs>(?:\"[^\"]*\"|[^{}\"])*)\})?")
 _TYPE_RE = re.compile(r"tensor<[^>]+>")
 
 

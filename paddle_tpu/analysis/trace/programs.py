@@ -263,6 +263,65 @@ def _build_serving() -> List[TraceProgram]:
     return out
 
 
+@register_builder("serving_tp", prefix="serving/")
+def _build_serving_tp() -> List[TraceProgram]:
+    """The tensor-parallel sharded twins (ISSUE 12): the SAME serving
+    entry fns jitted with the tp=2 engine's in/out shardings on a
+    2-device ('mp',) CPU mesh — composed int8 + speculative, so TPU502
+    confirms the code AND scale pool donations materialize as per-shard
+    input/output aliasing, and TPU503's SPMD checks audit the lowered
+    num_partitions and the partitioned program's collectives.  Skips
+    (loudly, like the pipeline builder) when the backend has fewer than
+    2 devices — the CLI must run under shell-level
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` (CI does)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        raise ProgramSkip(
+            "tensor-parallel serving programs need >= 2 devices; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count before "
+            "the backend initializes")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dtype import x64_scope
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    eng = DecodeEngine(model, num_slots=2, max_len=64, page_size=16,
+                       tp=2, spec_k=4, kv_dtype="int8")
+    mesh_axes = {ax: int(eng.mesh.shape[ax]) for ax in eng.mesh.axis_names}
+    out: List[TraceProgram] = []
+    for name, entry, fn, donate, args in (
+            ("serving/decode_step_tp", "serving.decode",
+             eng._decode_fn, eng._decode_donate_argnums,
+             eng.decode_trace_args()),
+            ("serving/prefill_chunk_tp", "serving.prefill_chunk",
+             eng._prefill_chunk_fn, eng._prefill_chunk_donate_argnums,
+             eng.prefill_chunk_trace_args()),
+            ("serving/spec_verify_tp", "serving.spec_verify",
+             eng._verify_fn, eng._verify_donate_argnums,
+             eng.verify_trace_args())):
+        ins, outs = eng._entry_shardings[entry]
+        # keep_unused + the production shardings: the audited program is
+        # the sharded program that runs (see the `serving` builder for
+        # the keep_unused/donation-alignment rationale)
+        audit = jax.jit(fn, donate_argnums=donate, keep_unused=True,
+                        in_shardings=ins, out_shardings=outs)
+        with x64_scope(False), _mesh.mesh_scope(eng.mesh):
+            jaxpr = jax.make_jaxpr(audit)(*args)
+            lowered = audit.lower(*args)
+        out.append(TraceProgram(
+            name=name, jaxpr=jaxpr, lowered_text=lowered.as_text(),
+            lowered=lowered,
+            meta={"kind": "serving", "mesh_axes": mesh_axes,
+                  "spmd_sharded": True,
+                  "donate_labels": _donate_labels(args)}))
+    return out
+
+
 @register_builder("pallas_kernels", prefix="pallas/")
 def _build_pallas_kernels() -> List[TraceProgram]:
     import jax

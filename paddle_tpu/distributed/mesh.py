@@ -10,6 +10,7 @@ follows the reference's fixed hybrid order ["data", "pipe", "sharding",
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -91,6 +92,25 @@ def init_hybrid_mesh(dcn_axes: Dict[str, int], ici_axes: Dict[str, int],
 
 def get_mesh() -> Optional[Mesh]:
     return _global_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    """Temporarily install ``mesh`` as the global mesh (restored on exit).
+
+    The serving engine's tensor-parallel entries trace under this scope so
+    the model's ``with_sharding_constraint`` sites resolve the SERVING
+    mesh (a private ``('mp',)`` mesh over the TP devices) instead of
+    whatever training mesh the process may or may not have installed —
+    without the engine ever mutating global state beyond its own traced
+    calls."""
+    global _global_mesh
+    prev = _global_mesh
+    _global_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _global_mesh = prev
 
 
 def set_mesh(mesh: Mesh):

@@ -150,6 +150,22 @@ def with_sharding_constraint(t, spec):
     return _constrain(t, spec)
 
 
+def shard_heads(t):
+    """Constrain a ``(batch, seq, heads, head_dim)`` activation to be
+    HEAD-sharded on the mp axis — the serving engine's tensor-parallel
+    decode layout (the KV pool is partitioned over the same axis, so a
+    head-sharded q/k/v keeps the whole attention, pool scatter included,
+    device-local).  Column-sharding the FUSED qkv projection puts shard
+    boundaries at 3H/tp, not at head boundaries, so without this
+    constraint GSPMD resolves the q/k/v slices with a resharding
+    collective per layer anyway — the constraint just names the layout
+    once instead of letting propagation rediscover it.  No-op whenever
+    the active mesh does not declare 'mp' (single-chip decode, training
+    meshes without tensor parallelism)."""
+    return with_sharding_constraint(
+        t, PartitionSpec(None, None, MP_AXIS, None))
+
+
 def _constrain(x, spec):
     try:
         mesh = _mesh.get_mesh()

@@ -511,6 +511,12 @@ def standard_keys() -> List[tuple]:
     out.append(("decode_attn_paged", dat.paged_autotune_key(
         slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
         qlen=5, dtype=dtype)))
+    # tensor-parallel serving (ISSUE 12): the tp=2 sharded decode's
+    # PER-SHARD shape (8 of the 16 heads per chip) tunes under its own
+    # key so the next on-chip warm covers the multi-chip engine too
+    out.append(("decode_attn_paged", dat.paged_autotune_key(
+        slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
+        qlen=1, dtype=dtype, tp=2)))
     return out
 
 

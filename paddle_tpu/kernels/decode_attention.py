@@ -90,7 +90,7 @@ def dequantize_kv(codes, scales, dtype):
     return (codes.astype(jnp.float32) * scales[..., None]).astype(dtype)
 
 
-def autotune_key(slots, t, h, d, qlen, dtype, kv_dtype=None):
+def autotune_key(slots, t, h, d, qlen, dtype, kv_dtype=None, tp=1):
     from . import autotune as at
     key = {"slots": int(slots), "t": int(t), "h": int(h), "d": int(d),
            "qlen": int(qlen), "dtype": str(jnp.dtype(dtype)),
@@ -99,6 +99,24 @@ def autotune_key(slots, t, h, d, qlen, dtype, kv_dtype=None):
         # only quantized keys carry the field: unquantized keys (and any
         # persisted cache entries for them) stay byte-identical to PR 7's
         key["kv_dtype"] = str(jnp.dtype(kv_dtype))
+    return _apply_tp(key, tp)
+
+
+def _apply_tp(key, tp):
+    """Tensor-parallel keys price the PER-SHARD program: under the head-
+    partitioned serving mesh each chip runs ``h / tp`` heads, so the
+    timed runner operands, the VMEM working set TPU504-style pricing
+    sees, and any persisted winner all describe what ONE device
+    executes.  ``tp`` stays in the key so a sharded winner can never be
+    served to (or clobber) the unsharded shape — and tp=1 keys stay
+    byte-identical to the pre-TP cache entries."""
+    tp = int(tp)
+    if tp > 1:
+        if key["h"] % tp:
+            raise ValueError("heads %d not divisible by tp %d"
+                             % (key["h"], tp))
+        key["h"] //= tp
+        key["tp"] = tp
     return key
 
 
@@ -257,7 +275,7 @@ def _dispatch(cand, q, k, v, pos, scale, k_scales=None, v_scales=None):
 
 
 def decode_attention(q, k, v, lengths, scale=None, k_scales=None,
-                     v_scales=None):
+                     v_scales=None, tp=1):
     """Length-masked attention for the slotted decode step (raw arrays).
 
     q: (slots, s, heads, d); k/v: (slots, max_len, heads, d);
@@ -265,12 +283,15 @@ def decode_attention(q, k, v, lengths, scale=None, k_scales=None,
     rows were already written at [lengths, lengths+s), so query offset j
     attends keys t <= lengths + j).  For the int8 cache, k/v are the code
     arrays and ``k_scales/v_scales: (slots, max_len, heads)`` f32 select
-    the q8 variants (dequantize inline).
+    the q8 variants (dequantize inline).  ``tp`` is the tensor-parallel
+    degree of the enclosing sharded program: trace-time shapes are
+    GLOBAL under jit-with-sharding, so the key records the per-shard
+    head count each device actually runs.
     """
     from . import autotune as at
     kv_dtype = None if k_scales is None else k.dtype
     key = autotune_key(q.shape[0], k.shape[1], q.shape[2], q.shape[3],
-                       q.shape[1], q.dtype, kv_dtype=kv_dtype)
+                       q.shape[1], q.dtype, kv_dtype=kv_dtype, tp=tp)
     cand = at.resolve("decode_attn", key)
     return _dispatch(cand, q, k, v, lengths, scale,
                      k_scales=k_scales, v_scales=v_scales)
@@ -282,7 +303,7 @@ def decode_attention(q, k, v, lengths, scale=None, k_scales=None,
 
 
 def paged_autotune_key(slots, pages, page_size, max_pages, h, d, qlen,
-                       dtype, kv_dtype=None):
+                       dtype, kv_dtype=None, tp=1):
     from . import autotune as at
     key = {"slots": int(slots), "pages": int(pages),
            "page_size": int(page_size), "max_pages": int(max_pages),
@@ -290,7 +311,7 @@ def paged_autotune_key(slots, pages, page_size, max_pages, h, d, qlen,
            "dtype": str(jnp.dtype(dtype)), "platform": at.platform()}
     if kv_dtype is not None:
         key["kv_dtype"] = str(jnp.dtype(kv_dtype))
-    return key
+    return _apply_tp(key, tp)
 
 
 def _gather_pages(kp, table):
@@ -424,7 +445,8 @@ def _dispatch_paged(cand, q, kp, vp, table, pos, scale, k_scales=None,
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           scale=None, k_scales=None, v_scales=None):
+                           scale=None, k_scales=None, v_scales=None,
+                           tp=1):
     """Length-masked attention over one layer's page pool (raw arrays).
 
     q: (slots, s, heads, d); k_pages/v_pages: (num_pages, page_size,
@@ -434,14 +456,16 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     keys t <= lengths + j; unmapped entries gather page 0 and are
     masked).  For the int8 pool, k_pages/v_pages are code arrays and
     ``k_scales/v_scales: (num_pages, page_size, heads)`` f32 select the
-    q8 variants (dequantize inline in the gather).
+    q8 variants (dequantize inline in the gather).  ``tp`` records the
+    tensor-parallel degree so the autotune key prices the PER-SHARD
+    head count (trace-time shapes are global under jit-with-sharding).
     """
     from . import autotune as at
     kv_dtype = None if k_scales is None else k_pages.dtype
     key = paged_autotune_key(q.shape[0], k_pages.shape[0],
                              k_pages.shape[1], page_table.shape[1],
                              q.shape[2], q.shape[3], q.shape[1], q.dtype,
-                             kv_dtype=kv_dtype)
+                             kv_dtype=kv_dtype, tp=tp)
     cand = at.resolve("decode_attn_paged", key)
     return _dispatch_paged(cand, q, k_pages, v_pages, page_table, lengths,
                            scale, k_scales=k_scales, v_scales=v_scales)

@@ -25,7 +25,7 @@ from jax.sharding import PartitionSpec
 
 from .. import ops
 from ..core.tensor import Tensor
-from ..distributed.mp_layers import with_sharding_constraint
+from ..distributed.mp_layers import shard_heads, with_sharding_constraint
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer.common import Dropout, Embedding, Linear
@@ -135,7 +135,11 @@ class GPTAttention(Layer):
         if cache is not None and not isinstance(cache, (tuple, list)):
             # static slotted cache (serving.cache view): append into the
             # preallocated buffers + length-masked attention — one shape
-            # for the life of the process, no per-token retrace
+            # for the life of the process, no per-token retrace.  Under a
+            # tensor-parallel serving mesh the q/k/v activations are
+            # pinned head-sharded so the cached attention (and the pool
+            # scatter) stays device-local (no-op without an 'mp' mesh)
+            q, k, v = shard_heads(q), shard_heads(k), shard_heads(v)
             out = cache.attend(q, k, v)
             out = ops.reshape(out, [b, s, self.hidden_size])
             return self.resid_dropout(self.out_proj(out)), cache
@@ -242,7 +246,10 @@ def _scan_block_apply(x, p, cfg, *, training, keys=None, cache=None):
     v = qkv[..., 2 * h_sz:].reshape(b, s, nh, hd)
     if cache is not None and not isinstance(cache, (tuple, list)):
         # static slotted cache view (serving.cache): in-place append +
-        # length-masked attention — no shape growth, no retrace
+        # length-masked attention — no shape growth, no retrace.  Head-
+        # sharded under a tensor-parallel serving mesh (see
+        # GPTAttention.forward; no-op without an 'mp' mesh)
+        q, k, v = shard_heads(q), shard_heads(k), shard_heads(v)
         out = cache.attend_raw(q, k, v)
     elif cache is not None:
         # LEGACY CONCAT SHIM (see GPTForCausalLM.gen_legacy_concat_cache)
@@ -645,22 +652,27 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0,
-                 num_slots=None, max_len=None, greedy=None):
+                 num_slots=None, max_len=None, greedy=None, **engine_kw):
         """Generate continuations through the serving engine (static
-        slotted cache + continuous-batching decode — the decode step
+        paged cache + continuous-batching decode — the decode step
         compiles once, not once per token).
 
         ``input_ids``: (batch, prompt_len) int array (or a list of 1-D
         prompts of different lengths).  Returns a list of 1-D int32
         numpy arrays of generated tokens (prompt excluded).
-        ``greedy=True`` is shorthand for temperature 0."""
+        ``greedy=True`` is shorthand for temperature 0.  Extra keyword
+        arguments reach the engine geometry (``serving.engine_for``):
+        ``tp=N`` decodes tensor-parallel over N chips (ISSUE 12),
+        ``kv_dtype="int8"`` / ``spec_k=k`` select the quantized /
+        speculative modes."""
         from ..serving import generate as _generate
         if greedy:
             temperature = 0.0
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          temperature=temperature, top_k=top_k, top_p=top_p,
                          eos_token_id=eos_token_id, seed=seed,
-                         num_slots=num_slots, max_len=max_len)
+                         num_slots=num_slots, max_len=max_len,
+                         **engine_kw)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -84,6 +84,16 @@ CATALOG = {
         "verify step's int8 KV appends (opt-in: "
         "PADDLE_TPU_METRICS_KV_QUANT_ERROR=1 at engine construction; "
         "forces one device sync per step)"),
+    "serving.tp_degree": _m(
+        "gauge", "tensor-parallel degree of the most recently "
+        "constructed decode engine (1 = single-chip; tp > 1 partitions "
+        "the paged KV pool over heads on an ('mp',) mesh)"),
+    "serving.collective_bytes": _m(
+        "counter", "bytes the sharded decode/verify step's collectives "
+        "move over the mesh per iteration, priced once from the "
+        "compiled program's partitioned HLO (opt-in: "
+        "PADDLE_TPU_METRICS_COLLECTIVES=1 at engine construction; "
+        "first step pays one AOT compile for the price)"),
 
     # -- training (TrainStep / hapi fit / amp / divergence sentinel) --------
     "train.step_seconds": _m(

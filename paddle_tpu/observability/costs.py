@@ -180,6 +180,87 @@ def memory_analysis_dict(compiled) -> Dict[str, int]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# partitioned-collective pricing (ISSUE 12): XLA's cost_analysis does not
+# break bytes out by collective, so the SPMD-partitioned HLO text is the
+# source — every all-reduce/all-gather/... instruction's result shape,
+# summed.  The serving engine's per-step collective-bytes counter and the
+# TPU503 SPMD audit both read this.
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_HLO_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                       "collective-permute", "all-to-all")
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+#: `dtype[d0,d1,...]` shape tokens in an HLO instruction's result slot
+_HLO_SHAPE_RE = None
+
+
+def _hlo_shape_bytes(span: str) -> int:
+    """Sum the bytes of every ``dtype[dims]`` shape token in ``span``
+    (handles tuple-shaped results like async collective starts)."""
+    global _HLO_SHAPE_RE
+    import re
+    if _HLO_SHAPE_RE is None:
+        _HLO_SHAPE_RE = re.compile(
+            r"\b(%s)\[([\d,]*)\]" % "|".join(_HLO_DTYPE_BYTES))
+    total = 0
+    for dt, dims in _HLO_SHAPE_RE.findall(span):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _HLO_DTYPE_BYTES[dt]
+    return total
+
+
+def collective_stats(compiled) -> Optional[Dict[str, int]]:
+    """``{"ops": N, "bytes": B}`` over the collective instructions of a
+    compiled (post-SPMD-partitioning) executable's optimized HLO, or
+    ``None`` when the backend exposes no HLO text.  ``bytes`` sums each
+    collective's RESULT shape — the data one step moves over the mesh.
+    Async pairs are counted once, at the ``-done`` (whose result is the
+    OUTPUT buffer alone; a ``-start``'s tuple result carries the input
+    buffer and context fields too, which would over-price an async
+    lowering ~1.5x vs the sync form of the same program).  Caveat: this
+    is a STATIC instruction count — a collective inside a while/scan
+    body is priced once, not per trip (the serving decode's per-layer
+    walk is a python loop, so its entries unroll; priced exactly)."""
+    import re
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not isinstance(text, str):
+        return None
+    ops = 0
+    total = 0
+    names = "|".join(_COLLECTIVE_HLO_OPS)
+    head = r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+(?:" + names + r")"
+    sync_pat = re.compile(head + r"\(")
+    done_pat = re.compile(head + r"-done\(")
+    start_pat = re.compile(head + r"-start\(")
+    for line in text.splitlines():
+        m = done_pat.match(line)
+        if m:
+            ops += 1
+            total += _hlo_shape_bytes(m.group(1))
+            continue
+        if start_pat.match(line):
+            continue    # priced at its -done
+        m = sync_pat.match(line)
+        if m:
+            ops += 1
+            total += _hlo_shape_bytes(m.group(1))
+    return {"ops": ops, "bytes": total}
+
+
 @dataclasses.dataclass
 class ProgramReport:
     """XLA's cost + memory view of one compiled program.
@@ -205,6 +286,11 @@ class ProgramReport:
     alias_bytes: Optional[int] = None
     generated_code_bytes: Optional[int] = None
     peak_bytes: Optional[int] = None
+    #: ISSUE 12: collective instructions / result bytes in the
+    #: partitioned HLO (None when the backend exposes no HLO text;
+    #: 0/0 for a genuinely collective-free single-chip program)
+    collective_ops: Optional[int] = None
+    collective_bytes: Optional[int] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -232,6 +318,7 @@ def report_from_compiled(name: str, compiled, backend: Optional[str] = None,
             backend = ""
     ca = cost_analysis_dict(compiled)
     mem = memory_analysis_dict(compiled)
+    coll = collective_stats(compiled)
     return ProgramReport(
         name=name, backend=backend, available=True, note=note,
         flops=(float(ca["flops"]) if "flops" in ca else None),
@@ -245,6 +332,8 @@ def report_from_compiled(name: str, compiled, backend: Optional[str] = None,
         alias_bytes=mem.get("alias_bytes"),
         generated_code_bytes=mem.get("generated_code_bytes"),
         peak_bytes=_derive_peak(mem),
+        collective_ops=(None if coll is None else coll["ops"]),
+        collective_bytes=(None if coll is None else coll["bytes"]),
     )
 
 

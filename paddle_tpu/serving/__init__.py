@@ -56,7 +56,7 @@ def __getattr__(name):
 _MAX_CACHED_ENGINES = 4
 
 
-def engine_for(model, num_slots=4, max_len=None, **kw):
+def engine_for(model, num_slots=4, max_len=None, tp=1, **kw):
     """A per-model engine cache: repeated :func:`generate` calls with the
     same geometry reuse the compiled decode program (the compile-once
     contract spans calls).  The engine re-snapshots the model parameters
@@ -64,10 +64,21 @@ def engine_for(model, num_slots=4, max_len=None, **kw):
     :data:`_MAX_CACHED_ENGINES` geometries are kept (LRU) — geometry is
     also bucketed by :func:`generate` so the default path reuses one.
     The RNG seed is NOT part of the geometry (it is a host-side base key
-    — callers reseed the cached engine instead of building another)."""
+    — callers reseed the cached engine instead of building another).
+
+    The tensor-parallel degree IS geometry: ``tp`` is a named parameter
+    normalized into the cache key, so a tp=2 request after a tp=1 one
+    builds a fresh engine with the head-sharded pool (reusing the
+    unsharded cache geometry would feed single-chip buffers to the
+    sharded program), while ``tp=1`` — spelled or defaulted — maps to
+    the SAME key as before (a kwargs-carried tp would have split them
+    into duplicate engines pinning two full KV pools).  ``tp`` engines
+    also re-shard the refreshed parameter snapshot onto their mesh
+    (``DecodeEngine.refresh_state``)."""
     from .engine import DecodeEngine
     key = (int(num_slots), max_len if max_len is None else int(max_len),
-           tuple(sorted(kw.items())))
+           int(tp), tuple(sorted(kw.items())))
+    kw = dict(kw, tp=int(tp))
     cache = model.__dict__.get("_serving_engines")
     if cache is None:
         cache = {}

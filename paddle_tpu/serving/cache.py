@@ -606,7 +606,7 @@ class PagedDecodeView(_CacheView):
     _extra_fields = ("page_table",)
 
     def __init__(self, cache: PagedKVCache, active=None, max_len=None,
-                 track_quant_err=False):
+                 track_quant_err=False, tp=1):
         super().__init__(cache, track_quant_err=track_quant_err)
         self.page_table = _unwrap(cache.page_table)
         self.active = None if active is None else _unwrap(active)
@@ -616,6 +616,10 @@ class PagedDecodeView(_CacheView):
         # slotted view's rows-past-max_len guard
         self.max_len = (int(max_len) if max_len is not None
                         else int(cache.max_len))
+        # tensor-parallel degree of the enclosing sharded program: the
+        # attention autotune key must price the PER-SHARD head count
+        # (trace-time shapes are global under jit-with-sharding)
+        self.tp = int(tp)
         self._steps = 0
 
     def position_ids(self, batch, seq_len):
@@ -645,13 +649,14 @@ class PagedDecodeView(_CacheView):
                 ksc=c["k_scale"], vsc=c["v_scale"], ks_new=ks, vs_new=vs)
             out = paged_decode_attention(
                 q, kc[:, layer], vc[:, layer], table, lengths, scale=scale,
-                k_scales=ksc[:, layer], v_scales=vsc[:, layer])
+                k_scales=ksc[:, layer], v_scales=vsc[:, layer],
+                tp=self.tp)
             mut = (kc, vc, ksc, vsc) + (() if err is None else (err,))
             return (out,) + mut
         kc, vc, _, _ = paged_scatter(kc, vc, layer, table, pos, valid,
                                      k_new, v_new)
         out = paged_decode_attention(q, kc[:, layer], vc[:, layer], table,
-                                     lengths, scale=scale)
+                                     lengths, scale=scale, tp=self.tp)
         return out, kc, vc
 
     def finalize(self, advance=None) -> PagedKVCache:
@@ -687,13 +692,15 @@ class PagedPrefillChunkView(_CacheView):
 
     _extra_fields = ("page_table",)
 
-    def __init__(self, cache: PagedKVCache, slot, n_before, n_valid):
+    def __init__(self, cache: PagedKVCache, slot, n_before, n_valid,
+                 tp=1):
         super().__init__(cache)
         self.page_table = _unwrap(cache.page_table)
         self.slot = jnp.asarray(_unwrap(slot), jnp.int32)
         self.n_before = jnp.asarray(_unwrap(n_before), jnp.int32)
         self.n_valid = jnp.asarray(_unwrap(n_valid), jnp.int32)
         self.declared_max_len = cache.declared_max_len
+        self.tp = int(tp)    # per-shard autotune keys (PagedDecodeView)
 
     def position_ids(self, batch, seq_len):
         if batch != 1:
@@ -721,13 +728,14 @@ class PagedPrefillChunkView(_CacheView):
                 ksc=c["k_scale"], vsc=c["v_scale"], ks_new=ks, vs_new=vs)
             out = paged_decode_attention(
                 q, kc[:, layer], vc[:, layer], row_tab, self.n_before[None],
-                scale=scale, k_scales=ksc[:, layer], v_scales=vsc[:, layer])
+                scale=scale, k_scales=ksc[:, layer], v_scales=vsc[:, layer],
+                tp=self.tp)
             return out, kc, vc, ksc, vsc
         kc, vc, _, _ = paged_scatter(kc, vc, layer, row_tab, pos, valid,
                                      k_new, v_new)
         out = paged_decode_attention(q, kc[:, layer], vc[:, layer],
                                      row_tab, self.n_before[None],
-                                     scale=scale)
+                                     scale=scale, tp=self.tp)
         return out, kc, vc
 
     def finalize(self) -> PagedKVCache:

@@ -47,6 +47,28 @@ Two cache layouts (``paged=True`` is the default — ISSUE 7):
   parity): per-slot contiguous ``max_len`` buffers, bucketed whole-
   prompt prefill.
 
+**Tensor-parallel sharded decode (``tp=N`` — ISSUE 12).**  The paged
+engine decodes MULTI-CHIP: the KV pool (codes AND the int8 scale pools)
+is partitioned over the HEADS axis of a private ``('mp',)`` mesh, the
+model parameters carry their Megatron pspec annotations (qkv/fc1
+column-, out/fc2 row-, embeddings vocab-sharded — the SAME machinery
+the training TP path uses, ``distributed/mp_layers.py``), and every
+jitted entry (decode, prefill_chunk, cow_copy, spec_verify) becomes its
+sharded twin via ``jax.jit`` with in/out shardings — GSPMD inserts
+exactly the collectives the training path gets (psum after the
+row-parallel matmuls, the vocab-parallel logits gather), audited by
+TPU503 on the lowered sharded entries.  Page table, lengths, tokens and
+the whole sampling state stay REPLICATED; the host-side bookkeeping
+(:class:`~.pages.PageAllocator`, the length mirror) is untouched —
+sharding divides bytes, never meaning.  Donation stays intact (TPU502:
+the sharded pool aliases input→output per shard), the compile-once
+discipline holds (ONE sharded program per entry across slot churn,
+prefix hits, chunked admissions and spec verify), and per-chip decode
+KV bytes/token drop to ``1/tp`` of the single-chip bound
+(``kv_row_bytes``/``kv_pool_bytes``/``kv_bytes_per_token`` all report
+PER-SHARD truth).  ``tp=1`` (the default) is byte-identical to the
+unsharded engine.
+
 **int8 KV cache (``kv_dtype="int8"`` — ISSUE 8).**  Either layout can
 store the pool as int8 codes + per-(row, head) f32 scales
 (:mod:`.cache`): appends quantize in-program, the attention families'
@@ -87,9 +109,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.dtype import x64_scope
 from ..core.tensor import Tensor
+from ..distributed import mesh as _mesh
+from ..distributed.mp_layers import MP_AXIS
 from ..observability import flight as _flight
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
@@ -158,7 +183,7 @@ class DecodeEngine:
                  min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True,
                  paged=True, page_size=64, num_pages=None,
                  prefill_chunk=None, kv_dtype=None, spec_k=0,
-                 spec_ngram=3, tracer=None):
+                 spec_ngram=3, tracer=None, tp=1):
         cfg = model.config
         self.model = model
         # request-scoped tracing (ISSUE 9): the engine lane carries one
@@ -175,6 +200,13 @@ class DecodeEngine:
         self.top_k_max = int(top_k_max)
         self.paged = bool(paged)
         self.state = model.functional_state()
+        # the UNSHARDED snapshot leaves, kept for refresh_state's
+        # param-change identity test: tp engines replace self.state with
+        # device_put COPIES, so comparing fresh functional_state leaves
+        # against self.state would read "changed" on every cached-engine
+        # reuse — silently dropping the prefix cache and re-uploading
+        # the whole parameter tree per generate() round
+        self._state_src_leaves = jax.tree_util.tree_leaves(self.state)
         if cache_dtype is None:
             # match the activation dtype: the embedding weight's dtype is
             # what the residual stream (and so K/V) runs in
@@ -210,12 +242,55 @@ class DecodeEngine:
         if self.spec_k >= self.max_len:
             raise ValueError("spec_k %d must be < max_len %d"
                              % (self.spec_k, self.max_len))
+        # -- tensor parallelism (ISSUE 12) ---------------------------------
+        self.tp = int(tp)
+        if self.tp < 1:
+            raise ValueError("tp must be >= 1")
+        if self.tp > 1 and not self.paged:
+            raise ValueError(
+                "tensor-parallel decode runs on the paged engine (tp > 1 "
+                "with paged=False is not supported — the slotted layout "
+                "is the single-chip A/B baseline)")
+        self.mesh = None
+        self._param_shard_specs = {}
+        self._entry_shardings = {}
+        if self.tp > 1:
+            devices = jax.devices()
+            if len(devices) < self.tp:
+                raise ValueError(
+                    "tp=%d needs %d devices, have %d (CPU: set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count before the "
+                    "backend initializes)"
+                    % (self.tp, self.tp, len(devices)))
+            if self._heads % self.tp:
+                raise ValueError(
+                    "tp=%d must divide num_attention_heads=%d (the KV "
+                    "pool is partitioned over heads)"
+                    % (self.tp, self._heads))
+            # a PRIVATE single-axis mesh over the first tp devices — the
+            # engine never mutates the process-global mesh; its traced
+            # calls install this one via mesh_scope so the model's
+            # with_sharding_constraint sites (incl. the head constraints
+            # in the cache walk) resolve the serving topology
+            self.mesh = Mesh(np.asarray(devices[:self.tp]), (MP_AXIS,))
+            self._param_shard_specs = self._collect_param_specs()
+            self.state = self._shard_state(self.state)
         self._base_key = jax.random.key(int(seed))
         self._rng_step = 0
         # metric handles, fetched once (no-op singletons when disabled)
         self._m_pool = _metrics.gauge("serving.page_pool_used")
         self._m_cow = _metrics.counter("serving.cow_copies")
         self._m_qerr = _metrics.gauge("serving.kv_quant_error")
+        self._m_tp = _metrics.gauge("serving.tp_degree")
+        self._m_tp.set(self.tp)
+        self._m_coll = _metrics.counter("serving.collective_bytes")
+        # opt-in per-step collective-bytes accounting: priced ONCE per
+        # entry from the compiled sharded program's HLO (an extra AOT
+        # compile on first use — grad_norm-style env opt-in, read once)
+        self._track_coll = bool(
+            self.tp > 1 and os.environ.get(
+                "PADDLE_TPU_METRICS_COLLECTIVES", "0") == "1")
+        self._coll_price = {}
         # decode KV-read accounting (the bench's kv_bytes_per_token A/B):
         # per decode/verify step, `paged_rows` accrues the rows a
         # length-aware paged schedule reads (mapped pages, ONE sweep per
@@ -246,6 +321,100 @@ class DecodeEngine:
 
     def _cache_scale_args(self):
         return (self.cache.k_scale, self.cache.v_scale)
+
+    # ------------------------------------------------------------------
+    # tensor-parallel sharding (ISSUE 12) — tp=1 engines never enter any
+    # of these paths; tp>1 is paged-only (validated in __init__)
+    # ------------------------------------------------------------------
+
+    def _collect_param_specs(self):
+        """{state name: PartitionSpec} from the parameters' Megatron
+        pspec annotations (``distributed/mp_layers.py`` layouts baked
+        into ``models/gpt.py``), filtered to the serving mesh's axes —
+        training annotations also name dp/sep axes this single-purpose
+        ('mp',) mesh does not carry.  A pspec IS one PartitionSpec, not
+        a tuple of them (the TrainStep lesson).  Raises on a sharded dim
+        the TP degree does not divide: GSPMD would reject the uneven
+        NamedSharding at dispatch anyway, but this names the parameter."""
+        axis_names = set(self.mesh.axis_names)
+        specs = {}
+        for name, t in self.model.state_dict().items():
+            spec = getattr(t, "pspec", None)
+            if spec is None:
+                specs[name] = PartitionSpec()
+                continue
+            kept = []
+            for el in tuple(spec):
+                if isinstance(el, str):
+                    kept.append(el if el in axis_names else None)
+                elif isinstance(el, (tuple, list)):
+                    sub = tuple(a for a in el if a in axis_names)
+                    kept.append(sub if sub else None)
+                else:
+                    kept.append(None)
+            for dim, el in enumerate(kept):
+                if el is not None and t.shape[dim] % self.tp:
+                    raise ValueError(
+                        "parameter %r dim %d (size %d) is mp-sharded "
+                        "but not divisible by tp=%d"
+                        % (name, dim, int(t.shape[dim]), self.tp))
+            specs[name] = PartitionSpec(*kept)
+        return specs
+
+    def _sh(self, *spec):
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def _state_shardings(self):
+        return {k: NamedSharding(self.mesh, self._param_shard_specs[k])
+                for k in self.state}
+
+    def _shard_state(self, state):
+        """Place a freshly snapshotted parameter tree onto the serving
+        mesh per its pspec annotations.  Required, not cosmetic: after
+        training, ``functional_state`` leaves are committed to their
+        training placement, and feeding them to the sharded entries'
+        ``in_shardings`` raises a device-assignment mismatch instead of
+        silently resharding (the ``refresh_state`` regression)."""
+        if self.tp <= 1:
+            return state
+        sh = {k: NamedSharding(self.mesh, self._param_shard_specs[k])
+              for k in state}
+        return {k: jax.device_put(v, sh[k]) for k, v in state.items()}
+
+    def _jit_kwargs(self, entry):
+        """The sharding kwargs a given entry's jit (and any AOT re-jit
+        that must price the SAME program — ``cost_reports``) carries:
+        one definition so the served and the priced program can never
+        drift."""
+        if entry not in self._entry_shardings:
+            return {}
+        ins, outs = self._entry_shardings[entry]
+        return dict(in_shardings=ins, out_shardings=outs)
+
+    def _trace_scope(self):
+        """Mesh context for the compiled entries' traced calls: the
+        model's with_sharding_constraint sites — incl. the head
+        constraints on the cache walk — must resolve the SERVING
+        topology, whatever the process-global mesh is.  tp=1 engines
+        install ``None`` (not a no-op!): a leftover TRAINING mesh
+        declaring 'mp' would otherwise turn the single-chip decode
+        trace into an SPMD program over the training devices — the
+        'tp=1 is byte-identical to the unsharded engine' contract must
+        hold in mesh-laden processes too."""
+        return _mesh.mesh_scope(self.mesh)
+
+    def _collective_price(self, entry):
+        """Collective bytes ONE step of ``entry`` moves over the mesh,
+        priced lazily from the compiled sharded program's partitioned
+        HLO (``observability.costs.collective_stats``) and cached — the
+        per-step counter increments by this constant."""
+        price = self._coll_price.get(entry)
+        if price is None:
+            from ..observability import costs as _costs
+            report = self.cost_reports(only=(entry,))[entry]
+            price = int(report.collective_bytes or 0)
+            self._coll_price[entry] = price
+        return price
 
     # ------------------------------------------------------------------
     # slotted mode (PR 5 layout — kept for A/B and parity)
@@ -350,11 +519,28 @@ class DecodeEngine:
             self.num_pages, self._layers, self.page_size, self._heads,
             self._head_dim, self.num_slots, self.max_pages,
             self._cache_dtype, kv_dtype=self._kv_dtype_arg())
+        if self.tp > 1:
+            # the pool lives HEAD-SHARDED from birth: each chip holds
+            # 1/tp of the KV bytes (the whole point), and the sharded
+            # entries' donated aliasing needs matching input placement
+            c = self.cache
+            pool = self._sh(None, None, None, MP_AXIS, None)
+            scale = self._sh(None, None, None, MP_AXIS)
+            rep = self._sh()
+            self.cache = PagedKVCache(
+                jax.device_put(c.k, pool), jax.device_put(c.v, pool),
+                jax.device_put(c.page_table, rep),
+                jax.device_put(c.lengths, rep),
+                k_scale=(None if c.k_scale is None
+                         else jax.device_put(c.k_scale, scale)),
+                v_scale=(None if c.v_scale is None
+                         else jax.device_put(c.v_scale, scale)))
         # hoist everything the traced closures need: capturing `self`
         # would pin the whole engine (buffers included) to the jitted fns
         model, k_max, L_max = self.model, self.top_k_max, self.max_len
         track_qerr = self._track_qerr
         quantized = self._quantized
+        tp_deg = self.tp
 
         def decode_fn(state, cache_k, cache_v, k_scale, v_scale, lengths,
                       page_table, tokens, active, key, temps, top_ks,
@@ -364,7 +550,8 @@ class DecodeEngine:
             view = PagedDecodeView(
                 PagedKVCache(cache_k, cache_v, page_table, lengths,
                              k_scale=k_scale, v_scale=v_scale),
-                active=active, max_len=L_max, track_quant_err=track_qerr)
+                active=active, max_len=L_max, track_quant_err=track_qerr,
+                tp=tp_deg)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
@@ -385,7 +572,8 @@ class DecodeEngine:
             view = PagedDecodeView(
                 PagedKVCache(cache_k, cache_v, page_table, lengths,
                              k_scale=k_scale, v_scale=v_scale),
-                active=active, max_len=L_max, track_quant_err=track_qerr)
+                active=active, max_len=L_max, track_quant_err=track_qerr,
+                tp=tp_deg)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
@@ -414,7 +602,7 @@ class DecodeEngine:
             view = PagedPrefillChunkView(
                 PagedKVCache(cache_k, cache_v, page_table, lengths,
                              k_scale=k_scale, v_scale=v_scale),
-                slot, n_before, n_valid)
+                slot, n_before, n_valid, tp=tp_deg)
             from ..jit import functional_call
             (logits, _), _ = functional_call(model, state, Tensor(tokens),
                                              cache=view)
@@ -464,10 +652,48 @@ class DecodeEngine:
         self._cow_fn = cow_copy_fn
         self._cow_donate_argnums = \
             ((0, 1) + ((2, 3) if q else ())) if donate else ()
+        if self.tp > 1:
+            # every entry's SHARDED TWIN is the same traced fn jitted
+            # with explicit in/out shardings: pool (+ scale pools)
+            # head-sharded, everything that varies per step replicated.
+            # Donated pool inputs and their outputs carry the SAME
+            # sharding, so XLA's input→output aliasing materializes per
+            # shard (TPU502 audits the lowered sharded entries).  The
+            # scale slots are None-sharded when unquantized (the args
+            # are None) and the quant_err output likewise when tracking
+            # is off — None means "no leaves here", not replication.
+            rep = self._sh()
+            pool = self._sh(None, None, None, MP_AXIS, None)
+            scale = self._sh(None, None, None, MP_AXIS) if q else None
+            qe = rep if self._track_qerr else None
+            state_sh = self._state_shardings()
+            decode_in = (state_sh, pool, pool, scale, scale, rep, rep,
+                         rep, rep, rep, rep, rep, rep)
+            self._entry_shardings = {
+                "serving.decode": (
+                    decode_in,
+                    (rep, rep, pool, pool, scale, scale, rep, qe)),
+                "serving.spec_verify": (
+                    decode_in,
+                    (rep, rep, rep, pool, pool, scale, scale, rep, qe)),
+                "serving.prefill_chunk": (
+                    (state_sh, rep, rep, rep, rep, pool, pool, scale,
+                     scale, rep, rep, rep, rep, rep, rep),
+                    (rep, rep, pool, pool, scale, scale, rep)),
+                "serving.cow_copy": (
+                    (pool, pool, scale, scale, rep, rep),
+                    (pool, pool, scale, scale)),
+            }
+
+        def _jit(entry, fn, donate_argnums):
+            return jax.jit(fn, donate_argnums=donate_argnums,
+                           **self._jit_kwargs(entry))
+
         from ..observability.watchdog import watch
         self._decode = watch(
             "serving.decode",
-            jax.jit(decode_fn, donate_argnums=self._decode_donate_argnums),
+            _jit("serving.decode", decode_fn,
+                 self._decode_donate_argnums),
             expected=1)
         self._verify = None
         if self.spec_k:
@@ -475,19 +701,19 @@ class DecodeEngine:
             # stop — all-accept and all-reject are traced-value paths
             self._verify = watch(
                 "serving.spec_verify",
-                jax.jit(verify_fn,
-                        donate_argnums=self._verify_donate_argnums),
+                _jit("serving.spec_verify", verify_fn,
+                     self._verify_donate_argnums),
                 expected=1)
         # ONE chunk shape => ONE program (vs log2(max_len) buckets)
         self._prefill_chunk = watch(
             "serving.prefill_chunk",
-            jax.jit(prefill_chunk_fn,
-                    donate_argnums=self._prefill_chunk_donate_argnums),
+            _jit("serving.prefill_chunk", prefill_chunk_fn,
+                 self._prefill_chunk_donate_argnums),
             expected=1)
         self._cow = watch(
             "serving.cow_copy",
-            jax.jit(cow_copy_fn,
-                    donate_argnums=self._cow_donate_argnums),
+            _jit("serving.cow_copy", cow_copy_fn,
+                 self._cow_donate_argnums),
             expected=1)
 
     # -- host-side API -----------------------------------------------------
@@ -503,14 +729,30 @@ class DecodeEngine:
         immutable, so leaf identity is an exact change test."""
         new = state if state is not None else \
             self.model.functional_state()
+        # change test against the UNSHARDED source leaves (identity —
+        # jax arrays are immutable): tp engines hold device_put COPIES
+        # in self.state, so comparing against those would read every
+        # unchanged re-snapshot as a change — dropping the prefix cache
+        # and re-uploading the whole tree per cached-engine reuse
+        old_leaves = self._state_src_leaves
+        new_leaves = jax.tree_util.tree_leaves(new)
+        changed = (len(old_leaves) != len(new_leaves)
+                   or any(a is not b
+                          for a, b in zip(new_leaves, old_leaves)))
+        if not changed:
+            # every engine_for reuse lands here: keep the (possibly
+            # sharded) placed state AND the prefix cache
+            return
+        self._state_src_leaves = new_leaves
         if self.paged:
-            old_leaves = jax.tree_util.tree_leaves(self.state)
-            new_leaves = jax.tree_util.tree_leaves(new)
-            if (len(old_leaves) != len(new_leaves)
-                    or any(a is not b
-                           for a, b in zip(new_leaves, old_leaves))):
-                self._alloc.drop_prefix_cache()
-        self.state = new
+            self._alloc.drop_prefix_cache()
+        # tensor-parallel engines must RE-SHARD the changed snapshot:
+        # post-training leaves are committed to their training
+        # placement, and the sharded entries' in_shardings raise a
+        # device-assignment mismatch on a foreign device set instead of
+        # silently resharding (regression-tested); _shard_state is the
+        # identity for tp=1
+        self.state = self._shard_state(new)
 
     def reset(self):
         """Free every slot (paged: pages return to the pool and prefix
@@ -522,9 +764,17 @@ class DecodeEngine:
             self._alloc.reset()
             self._len_host[:] = 0
             self._m_pool.set(0)
+            lengths = jnp.zeros((self.num_slots,), jnp.int32)
+            if self.tp > 1:
+                # keep the lengths COMMITTED-replicated like every other
+                # call's (init device_puts, the sharded entries' outputs
+                # are committed): jit keys on commitment, so a fresh
+                # uncommitted zeros here would open a second cache entry
+                # on the next prefill_chunk — a compile-once violation
+                # the strict watchdog turns fatal mid-bench
+                lengths = jax.device_put(lengths, self._sh())
             self.cache = PagedKVCache(
-                c.k, c.v, self._alloc.device_table(),
-                jnp.zeros((self.num_slots,), jnp.int32),
+                c.k, c.v, self._alloc.device_table(), lengths,
                 k_scale=c.k_scale, v_scale=c.v_scale)
         else:
             self.cache = SlottedKVCache(
@@ -611,7 +861,7 @@ class DecodeEngine:
         if tr_on:
             c0 = self._cow.compile_count
             t0_ns = time.perf_counter_ns()
-        with x64_scope(False):
+        with x64_scope(False), self._trace_scope():
             k, v, ks, vs = self._cow(c.k, c.v, c.k_scale, c.v_scale,
                                      jnp.asarray(old_pid, jnp.int32),
                                      jnp.asarray(new_pid, jnp.int32))
@@ -727,7 +977,8 @@ class DecodeEngine:
         # index widening follow the global x64 default otherwise (same
         # discipline as the Pallas kernel entries; asserted over the
         # compiled HLO by tests/test_serving.py)
-        with x64_scope(False), _eval_scope(self.model):
+        with x64_scope(False), _eval_scope(self.model), \
+                self._trace_scope():
             tok, logits, k, v, ks, vs, lengths = self._prefill_chunk(
                 self.state, jnp.asarray(padded),
                 jnp.asarray(task.slot, jnp.int32),
@@ -784,7 +1035,8 @@ class DecodeEngine:
             c0 = self._prefill.compile_count
             t0_ns = time.perf_counter_ns()
         # x64/eval scopes: see prefill_step()
-        with x64_scope(False), _eval_scope(self.model):
+        with x64_scope(False), _eval_scope(self.model), \
+                self._trace_scope():
             tok, logits, k, v, ks, vs, lengths = self._prefill(
                 self.state, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
@@ -824,7 +1076,8 @@ class DecodeEngine:
             t0_ns = time.perf_counter_ns()
         # x64/eval scopes: see prefill_step() — keep the traced program
         # s64/f64-free and the caller's train/eval mode untouched
-        with x64_scope(False), _eval_scope(self.model):
+        with x64_scope(False), _eval_scope(self.model), \
+                self._trace_scope():
             # both layouts share one call shape; paged inserts the page
             # table after lengths (donated argnums are identical)
             table = (self._alloc.device_table(),) if self.paged else ()
@@ -859,6 +1112,10 @@ class DecodeEngine:
         if tr_on:
             self._dispatch_span("engine.decode", self._decode, t0_ns, c0)
         self._set_quant_err(qerr)
+        if self._track_coll:
+            # per-step collective bytes over the mesh (opt-in; priced
+            # once from the compiled sharded program, then a constant)
+            self._m_coll.inc(self._collective_price("serving.decode"))
         return np.asarray(tok), logits
 
     def decode_spec(self, tokens, drafts, active, temperature, top_k,
@@ -894,7 +1151,8 @@ class DecodeEngine:
         if tr_on:
             c0 = self._verify.compile_count
             t0_ns = time.perf_counter_ns()
-        with x64_scope(False), _eval_scope(self.model):
+        with x64_scope(False), _eval_scope(self.model), \
+                self._trace_scope():
             emitted, counts, logits, kk, v, ks, vs, lengths, qerr = \
                 self._verify(
                     self.state, self.cache.k, self.cache.v,
@@ -933,6 +1191,9 @@ class DecodeEngine:
                                            * emitted_total) / n_active
         self.kv_stats["paged_rows"] += self._alloc.mapped_rows_total()
         self._set_quant_err(qerr)
+        if self._track_coll:
+            self._m_coll.inc(
+                self._collective_price("serving.spec_verify"))
         return np.asarray(emitted), counts_np.astype(np.int64), logits
 
     def slot_lengths(self):
@@ -943,34 +1204,42 @@ class DecodeEngine:
         return np.asarray(self.cache.lengths)
 
     def kv_row_bytes(self):
-        """Bytes one K+V row (all layers, all heads) costs a decode
-        read.  int8: codes + the per-(row, head) f32 scale — the honest
-        read bound, not just the code bytes."""
+        """Bytes one K+V row costs a decode read PER CHIP (all layers,
+        this chip's ``heads / tp`` head shard).  int8: codes + the
+        per-(row, head) f32 scale — the honest read bound, not just the
+        code bytes.  Tensor parallelism divides the per-chip row by the
+        TP degree (the ISSUE-12 acceptance ratio): every derived figure
+        — ``kv_pool_bytes``, ``kv_bytes_per_token``, the HBM ledger —
+        inherits per-shard truth from this one place."""
         if self._quantized:
             per_head = self._head_dim * 1 + 4
         else:
             per_head = self._head_dim * self._cache_dtype.itemsize
-        return self._layers * self._heads * per_head * 2
+        return self._layers * (self._heads // self.tp) * per_head * 2
 
     def kv_pool_bytes(self):
-        """Total bytes the KV pool holds resident — the HBM ledger's
+        """Bytes the KV pool holds resident PER CHIP — the HBM ledger's
         ``hbm.kv_pool_bytes`` term.  Rows * ``kv_row_bytes()`` so the
-        int8 accounting (codes + scales) carries over: paged pools price
-        every page whether mapped or free (the allocation is static),
-        slotted pools the full ``slots * max_len`` buffer."""
+        int8 accounting (codes + scales) and the tensor-parallel head
+        split carry over: paged pools price every page whether mapped or
+        free (the allocation is static), slotted pools the full
+        ``slots * max_len`` buffer."""
         rows = (self.num_pages * self.page_size if self.paged
                 else self.num_slots * self.max_len)
         return rows * self.kv_row_bytes()
 
     def kv_bytes_per_token(self):
-        """Observed decode KV-read accounting: bytes per generated token
-        under (a) the paged true-length bound and (b) the slotted
-        ``slots*max_len`` bound — the bench's A/B line.  Row cost covers
-        K+V across all layers (int8: codes + scales).  Slotted engines
-        report only ``flat`` (their real read bound): a fabricated
-        ``paged: 0.0`` would read as a datum in the A/B trajectory.
-        Speculative steps amortize ONE paged sweep over every committed
-        token, so the paged line reflects both multiplicative levers."""
+        """Observed decode KV-read accounting PER CHIP: bytes per
+        generated token under (a) the paged true-length bound and (b)
+        the slotted ``slots*max_len`` bound — the bench's A/B line.  Row
+        cost covers K+V across all layers (int8: codes + scales;
+        tensor parallelism: this chip's head shard only, so a tp=2 line
+        reads ~1/2 the tp=1 bound — the ISSUE-12 acceptance ratio).
+        Slotted engines report only ``flat`` (their real read bound): a
+        fabricated ``paged: 0.0`` would read as a datum in the A/B
+        trajectory.  Speculative steps amortize ONE paged sweep over
+        every committed token, so the paged line reflects every
+        multiplicative lever at once."""
         row = self.kv_row_bytes()
         t = self.kv_stats["tokens"]
         out = {"flat": (float(self.num_slots * self.max_len * row)
@@ -1002,6 +1271,7 @@ class DecodeEngine:
             "max_len": self.max_len,
             "kv_dtype": str(self.kv_dtype),
             "spec_k": self.spec_k,
+            "tp": self.tp,
             "slot_lengths": lengths,
             "compile_counts": {
                 "decode": self.decode_compile_count,
@@ -1142,8 +1412,14 @@ class DecodeEngine:
             entries = [e for e in entries if e[0] in wanted]
         out = {}
         for name, fn, donate, args in entries:
-            with x64_scope(False):
-                compiled = jax.jit(fn, donate_argnums=donate) \
+            # tensor-parallel engines price the SHARDED twin — the
+            # program that actually serves, per-chip FLOPs/bytes and
+            # the partitioned collectives included (_jit_kwargs is the
+            # one source of the sharding kwargs, shared with the
+            # production jits)
+            with x64_scope(False), self._trace_scope():
+                compiled = jax.jit(fn, donate_argnums=donate,
+                                   **self._jit_kwargs(name)) \
                     .lower(*args).compile()
             out[name] = _costs.report_from_compiled(name, compiled)
         return out

@@ -223,6 +223,18 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
                               max_new_tokens=8, temperature=0.0))
     sched4.run()
 
+    # -- serving D: tensor-parallel sharded decode (ISSUE 12) — drives the
+    # tp_degree gauge past 1 and, via the opt-in, the per-step
+    # collective-bytes counter priced from the compiled sharded program
+    monkeypatch.setenv("PADDLE_TPU_METRICS_COLLECTIVES", "1")
+    tp_eng = DecodeEngine(model, num_slots=2, max_len=32, seed=0,
+                          page_size=8, tp=2)
+    monkeypatch.delenv("PADDLE_TPU_METRICS_COLLECTIVES")
+    tok, _ = tp_eng.prefill(0, rng.integers(0, cfg.vocab_size, (6,)),
+                            temperature=0.0)
+    tp_eng.decode([tok, 0], [True, False], [0.0, 0.0], [0, 0],
+                  [1.0, 1.0])
+
     # -- training: TrainStep (+ opt-in grad norm) and the hapi fit loop ----
     from paddle_tpu import hapi, nn
     from paddle_tpu.jit import TrainStep
@@ -321,6 +333,7 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     # metric objects existing): counters with observed activity
     for name in ("serving.prefix_hit_pages", "serving.cow_copies",
                  "serving.preemptions", "serving.spec_proposed_tokens",
+                 "serving.collective_bytes",
                  "train.amp_skipped_steps", "train.divergence_rollbacks"):
         total = sum(s.get("value", s.get("count", 0))
                     for s in snap[name]["series"])

@@ -50,11 +50,12 @@ gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec)`` cursor key matches (the ISSUE-8 A/B matrix
-  interleaves quantized/speculative lines in one trajectory), a >3%
-  drop in ``value`` fails.  CPU entries never perf-gate (smoke
-  numbers), so the gate arms itself automatically the first session
-  that records chip numbers;
+  kv_dtype, spec, tp)`` cursor key matches (the ISSUE-8 A/B matrix
+  interleaves quantized/speculative lines in one trajectory, and the
+  ISSUE-12 ``--tp`` axis adds tensor-parallel lines — a tp=2 line must
+  never gate against the tp=1 series), a >3% drop in ``value`` fails.
+  CPU entries never perf-gate (smoke numbers), so the gate arms itself
+  automatically the first session that records chip numbers;
 * **cost cursors (ISSUE 11)**: over the same like-for-like on-chip
   pairs, a >3% ``cost.mfu`` drop or >5% ``cost.peak_bytes`` growth
   fails — a perf PR that holds tokens/s by burning memory (or that
@@ -300,10 +301,12 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "backend": cfg.get("backend"),
             "model": cfg.get("model"),
             "cache_layout": line.get("cache_layout"),
-            # ISSUE-8 A/B axes: absent on pre-quant/spec lines — None
-            # then keys its own legacy cursor, so old series stay gated
+            # ISSUE-8/12 A/B axes: absent on pre-quant/spec/tp lines —
+            # None then keys its own legacy cursor, so old series stay
+            # gated
             "kv_dtype": line.get("kv_dtype"),
             "spec": line.get("spec"),
+            "tp": line.get("tp"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
                 "compile_counts", line.get("compile_counts")),
             "cost": (line.get("cost")
@@ -325,12 +328,13 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                                                       cc[key]))
 
     # gate 2 — on-chip regression between consecutive chip entries.
-    # One cursor per (model, cache_layout, kv_dtype, spec) within each
-    # metric: a series that interleaves layouts (bench_decode --both) or
-    # the ISSUE-8 quant/speculation axes (--kv-dtype bf16,int8 --spec
-    # off,4 emits a matrix per round) still compares like-for-like — a
-    # single cursor would skip every mismatched pair AND lose its
-    # anchor, leaving the gate silently inert (regression-tested).
+    # One cursor per (model, cache_layout, kv_dtype, spec, tp) within
+    # each metric: a series that interleaves layouts (bench_decode
+    # --both), the ISSUE-8 quant/speculation axes, or the ISSUE-12
+    # tensor-parallel axis (--tp 1,2 emits both lines per round) still
+    # compares like-for-like — a single cursor would skip every
+    # mismatched pair AND lose its anchor, leaving the gate silently
+    # inert (regression-tested).
     for metric, entries in series.items():
         prev_by_key = {}
         # PER-METRIC cost anchors: the last like-for-like entry whose
@@ -346,7 +350,7 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             if e["backend"] != "tpu":
                 continue
             key = (e.get("model"), e.get("cache_layout"),
-                   e.get("kv_dtype"), e.get("spec"))
+                   e.get("kv_dtype"), e.get("spec"), e.get("tp"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
