@@ -71,7 +71,7 @@ import numpy as np
 
 
 def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
-               trace_file: str = None):
+               overlap: bool = True, trace_file: str = None):
     import jax
 
     import paddle_tpu as paddle
@@ -131,7 +131,8 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
     shared_prompt = rng.integers(0, cfg.vocab_size, (prompt_len,))
 
     def drive(n_requests):
-        sched = ContinuousBatchingScheduler(engine, tracer=tracer)
+        sched = ContinuousBatchingScheduler(engine, tracer=tracer,
+                                            overlap=overlap)
         for i in range(n_requests):
             prompt = (shared_prompt if paged and i % 3 == 0
                       else rng.integers(0, cfg.vocab_size, (prompt_len,)))
@@ -148,7 +149,7 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
                                  temperature=0.0))
         t0 = time.perf_counter()
         results = sched.run()
-        return results, time.perf_counter() - t0
+        return results, time.perf_counter() - t0, sched
 
     # warmup drain: compiles prefill (one chunk program / one bucket) +
     # the decode-side step (decode, or the speculative verify) once
@@ -170,8 +171,16 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
     if tracer is not None:
         tracer.reset()  # the exported trace describes the timed drain
 
-    results, dt = drive(requests)
+    results, dt, sched = drive(requests)
     total_tokens = sum(r.tokens.size for r in results.values())
+    # host-gap/step (ISSUE 13): wall time per decode step during which
+    # NO step was dispatched-and-unconsumed — the only windows where the
+    # device can be token-starved by host work.  The sync loop pays the
+    # whole consume->dispatch host window every step; the overlapped
+    # loop pays only true pipeline bubbles (main() asserts the
+    # reduction when both modes run in one matrix).
+    host_gap_ms = 1e3 * sched.host_gap_seconds \
+        / max(sched.decode_steps_total, 1)
     ttft_ms = 1e3 * float(np.mean([r.ttft for r in results.values()]))
     tpot_ms = 1e3 * float(np.mean(
         [r.tpot for r in results.values() if r.tokens.size > 1]))
@@ -203,6 +212,8 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
         "kv_dtype": kv_dtype,
         "spec": spec,
         "tp": tp,
+        "overlap": overlap,
+        "host_gap_ms_per_step": round(host_gap_ms, 4),
         # the ISSUE-7/8/12 acceptance line: decode KV bytes read per
         # generated token PER CHIP — `paged` scales with TRUE lengths
         # (mapped pages, amortized over every spec-committed token),
@@ -278,6 +289,11 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
         }
     print(json.dumps(result))
     sys.stdout.flush()
+    # cross-mode A/B hooks for main(): the sync-vs-overlapped greedy
+    # bit-parity assert and the host-gap reduction check
+    tokens_by_rid = tuple(tuple(int(t) for t in results[r].tokens)
+                          for r in sorted(results))
+    return tokens_by_rid, host_gap_ms
 
 
 def main(argv=None):
@@ -306,6 +322,13 @@ def main(argv=None):
                          "only; tp devices required — CPU: set "
                          "XLA_FLAGS=--xla_force_host_platform_"
                          "device_count)")
+    ap.add_argument("--overlap", default="on",
+                    help="comma list of on|off: the overlapped host/"
+                         "device decode loop vs the sync A/B baseline "
+                         "(ISSUE 13).  When BOTH run for a "
+                         "configuration, greedy output is asserted "
+                         "bit-identical and the overlapped host-gap/"
+                         "step must not exceed the sync one")
     ap.add_argument("--trace-file", default=None, metavar="PATH",
                     help="export a request-scoped span trace (JSONL) of "
                          "the timed drain; feed it to `python -m "
@@ -350,11 +373,19 @@ def main(argv=None):
                      "XLA_FLAGS=--xla_force_host_platform_device_count)"
                      % (max(tps), max(tps), len(jax.devices())))
 
-    configs = [(paged, kv_dtype, spec, tp)
+    overlaps = []
+    for tok in str(args.overlap).split(","):
+        tok = tok.strip().lower()
+        if tok not in ("on", "off"):
+            ap.error("--overlap values must be on or off, got %r" % tok)
+        overlaps.append(tok == "on")
+
+    configs = [(paged, kv_dtype, spec, tp, ov)
                for paged in layouts
                for kv_dtype in kv_dtypes
                for spec in specs
                for tp in tps
+               for ov in overlaps
                # speculation AND tensor parallelism are paged-only
                if not ((spec or tp > 1) and not paged)]
     if not configs:
@@ -363,11 +394,35 @@ def main(argv=None):
         ap.error("no runnable configuration: speculative decode "
                  "(--spec > 0) and tensor parallelism (--tp > 1) need "
                  "the paged layout")
-    for paged, kv_dtype, spec, tp in configs:
+    ab = {}          # (paged, kv, spec, tp) -> {overlap: (tokens, gap)}
+    for paged, kv_dtype, spec, tp, ov in configs:
         # run_config resets the registry and resyncs the watchdog after
         # its own warmup drain, so no inter-config state scrub is needed
-        run_config(paged, kv_dtype, spec, tp=tp,
-                   trace_file=args.trace_file)
+        tokens, gap = run_config(paged, kv_dtype, spec, tp=tp, overlap=ov,
+                                 trace_file=args.trace_file)
+        ab.setdefault((paged, kv_dtype, spec, tp), {})[ov] = (tokens, gap)
+    # sync-vs-overlapped A/B (the ISSUE-13 acceptance): when both modes
+    # ran one configuration, greedy output must be BIT-IDENTICAL and
+    # the overlapped loop's host gap must not exceed the sync loop's
+    # (overlap hides host work behind device compute by construction —
+    # a regression here means the pipeline stalled).
+    for key, modes in ab.items():
+        if len(modes) < 2:
+            continue
+        (tok_s, gap_s), (tok_o, gap_o) = modes[False], modes[True]
+        if tok_s != tok_o:
+            raise SystemExit(
+                "bench_decode: sync-vs-overlapped greedy output DIVERGED "
+                "for config %r — the overlapped loop's reconciliation is "
+                "broken" % (key,))
+        if gap_o > gap_s:
+            raise SystemExit(
+                "bench_decode: overlapped host-gap/step (%.4f ms) "
+                "EXCEEDS the sync loop's (%.4f ms) for config %r — "
+                "the overlap is not overlapping" % (gap_o, gap_s, key))
+        print("bench_decode: sync-vs-overlapped A/B ok for %r — greedy "
+              "bit-identical, host-gap/step %.4f -> %.4f ms"
+              % (key, gap_s, gap_o), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,230 @@
+"""Benchmark: goodput-vs-QPS through the LIVE async serving front-end.
+
+The load harness half of ISSUE 13: starts the real HTTP front-end
+(`paddle_tpu.serving.frontend`) over the compiled engine, offers Poisson
+traffic at each requested QPS over a prompt/output length mix
+(`paddle_tpu.serving.loadgen`), and prints ONE JSON line per (QPS, mix)
+— the ``BENCH_serve_*`` trajectory format::
+
+  {"metric": "serve_goodput_tokens_per_sec", "value": N, "unit": "tok/s",
+   "qps": ..., "mix": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
+   "tpot_p50_ms": ..., "tpot_p99_ms": ..., "shed_rate": ...,
+   "cache_layout": ..., "kv_dtype": ..., "spec": ..., "tp": ...,
+   "overlap": ..., "metrics": {...}, "config": {...}}
+
+Every field the decode trajectory cursors key on rides along, plus the
+serve axes (qps, mix, overlap): ``tools/bench_schema.py --trajectory``
+gates serve lines like-for-like — >3% goodput drop OR >3% p99-TTFT
+growth between consecutive on-chip entries fails; CPU lines are smoke
+and never perf-gate.  TTFT/TPOT here are measured at the CLIENT (first
+delivered SSE token), so queueing, HTTP framing, and the scheduler
+thread handoff are all inside the number — the p99 is what a user
+would see, not what the engine dispatched.
+
+The engine runs the OVERLAPPED decode loop (``--overlap off`` for the
+sync A/B) under the STRICT recompile watchdog: the decode program must
+compile exactly once across the whole sweep — admission churn, shed
+bursts, mid-stream disconnects and all (the schema gate re-checks the
+reported count).
+
+On TPU: GPT-2 345M at serving shapes.  On CPU: the tiny head_dim-64
+smoke config (numbers are smoke; the line carries backend so the gate
+knows).  Knobs: PADDLE_TPU_BENCH_SLOTS / _REQUESTS.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    os.environ.setdefault("PADDLE_TPU_STRICT_COMPILE", "1")
+    ap = argparse.ArgumentParser(
+        prog="python bench_serve.py",
+        description="serving front-end load benchmark (goodput vs QPS)")
+    ap.add_argument("--qps", default="4,16",
+                    help="comma list of offered Poisson rates (one "
+                         "BENCH_serve line each)")
+    ap.add_argument("--mix", default="short",
+                    help="prompt/output length mix name (serving."
+                         "loadgen.MIXES: short|mixed|long)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per QPS point (default 12 CPU / 32 "
+                         "TPU; PADDLE_TPU_BENCH_REQUESTS overrides)")
+    ap.add_argument("--queue-limit", type=int, default=32,
+                    help="front-end admission bound (shed with 429 "
+                         "above it)")
+    ap.add_argument("--overlap", default="on", choices=("on", "off"),
+                    help="overlapped host/device decode loop (off = the "
+                         "sync A/B baseline)")
+    ap.add_argument("--kv-dtype", default="bf16", choices=("bf16", "int8"))
+    ap.add_argument("--spec", default="off",
+                    help="'off' or a speculative draft length k")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (needs tp devices)")
+    ap.add_argument("--trace-file", default=None, metavar="PATH",
+                    help="export the request-scoped span trace (JSONL) "
+                         "of the LAST QPS point's drive")
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import flight as _flight
+    from paddle_tpu.observability import tracing as _tracing
+    from paddle_tpu.observability import watchdog as _wd
+    from paddle_tpu.serving import loadgen
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.frontend import ServingFrontend
+
+    spec = 0 if args.spec in ("off", "0") else int(args.spec)
+    overlap = args.overlap == "on"
+    on_tpu = jax.default_backend() == "tpu"
+    if args.tp > len(jax.devices()):
+        raise SystemExit(
+            "bench_serve: --tp %d needs %d devices, have %d (CPU: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count)"
+            % (args.tp, args.tp, len(jax.devices())))
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig.gpt2_medium()
+        model_name = "gpt2_345m"
+        num_slots, requests, max_len, page_size = 8, 32, 1024, 64
+    else:
+        cfg = GPTConfig(vocab_size=512, max_position_embeddings=256,
+                        hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=2, intermediate_size=256)
+        model_name = "tiny_d64"
+        num_slots, requests, max_len, page_size = 4, 12, 128, 16
+    num_slots = int(os.getenv("PADDLE_TPU_BENCH_SLOTS", num_slots))
+    requests = int(args.requests if args.requests is not None
+                   else os.getenv("PADDLE_TPU_BENCH_REQUESTS", requests))
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    model.eval()
+
+    qps_list = [float(t) for t in str(args.qps).split(",") if t.strip()]
+    tracer = _tracing.Tracer() if args.trace_file else None
+    engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
+                          seed=0, page_size=page_size,
+                          kv_dtype=("int8" if args.kv_dtype == "int8"
+                                    else None),
+                          spec_k=spec, tracer=tracer, tp=args.tp)
+    fe = ServingFrontend(engine, queue_limit=args.queue_limit,
+                         overlap=overlap, tracer=tracer)
+    host, port = fe.start()
+    try:
+        # warmup drive: compiles prefill + the decode-side step once
+        loadgen.run_load_sync(host, port, qps=max(qps_list), n_requests=2,
+                              mix=args.mix, seed=99,
+                              vocab=cfg.vocab_size)
+        for qps in qps_list:
+            # percentiles must describe THIS point's drive (reset
+            # ordering per OBSERVABILITY.md: flight snapshot first,
+            # then registry reset, then watchdog shadow resync)
+            _flight.note_registry_reset()
+            obs.default_registry().reset()
+            _wd.resync_counter()
+            if tracer is not None:
+                tracer.reset()
+            # host-gap delta for THIS point only (one scheduler serves
+            # the whole sweep; idle arrival gaps are already excluded
+            # by the scheduler's pipeline-idle reset)
+            gap0 = fe.scheduler.host_gap_seconds
+            steps0 = fe.scheduler.decode_steps_total
+            summary = loadgen.run_load_sync(
+                host, port, qps=qps, n_requests=requests, mix=args.mix,
+                seed=0, vocab=cfg.vocab_size)
+
+            def _pcts(name):
+                h = obs.histogram(name)
+                return {"p50_ms": round(1e3 * h.percentile(0.50), 3),
+                        "p95_ms": round(1e3 * h.percentile(0.95), 3),
+                        "p99_ms": round(1e3 * h.percentile(0.99), 3),
+                        "count": h.count}
+
+            sched = fe.scheduler
+            line = {
+                "metric": "serve_goodput_tokens_per_sec",
+                "value": summary["goodput_tokens_per_sec"],
+                "unit": "tok/s",
+                # the serve trajectory cursor axes (bench_schema keys
+                # series on model+layout+kv+spec+tp+overlap+qps+mix)
+                "qps": summary["qps"],
+                "mix": summary["mix"],
+                "cache_layout": "paged",
+                "kv_dtype": args.kv_dtype,
+                "spec": spec,
+                "tp": args.tp,
+                "overlap": overlap,
+                # client-observed latency (the acceptance numbers)
+                "ttft_p50_ms": summary["ttft_p50_ms"],
+                "ttft_p99_ms": summary["ttft_p99_ms"],
+                "tpot_p50_ms": summary["tpot_p50_ms"],
+                "tpot_p99_ms": summary["tpot_p99_ms"],
+                "shed_rate": summary["shed_rate"],
+                "sent": summary["sent"],
+                "completed": summary["completed"],
+                "shed": summary["shed"],
+                "errors": summary["errors"],
+                "qps_achieved": summary["qps_achieved"],
+                "goodput_tokens": summary["goodput_tokens"],
+                "wall_s": summary["wall_s"],
+                "host_gap_ms_per_step": round(
+                    1e3 * (sched.host_gap_seconds - gap0)
+                    / max(sched.decode_steps_total - steps0, 1), 4),
+                "metrics": {
+                    "histograms": {
+                        "serving.ttft_seconds":
+                            _pcts("serving.ttft_seconds"),
+                        "serving.tpot_seconds":
+                            _pcts("serving.tpot_seconds"),
+                        "serving.queue_wait_seconds":
+                            _pcts("serving.queue_wait_seconds"),
+                        "serving.decode_step_seconds":
+                            _pcts("serving.decode_step_seconds"),
+                    },
+                    "compile_counts": {
+                        k: v for k, v in obs.compile_counts().items()
+                        if v > 0},
+                },
+                "config": {
+                    "model": model_name,
+                    "backend": jax.default_backend(),
+                    "num_slots": num_slots, "max_len": max_len,
+                    "queue_limit": args.queue_limit,
+                    "requests": requests, "tp": args.tp,
+                    "page_size": engine.page_size,
+                    "num_pages": engine.num_pages,
+                    "prefill_chunk": engine.prefill_chunk,
+                },
+            }
+            if summary["errors"]:
+                raise SystemExit(
+                    "bench_serve: %d requests errored (not shed) at "
+                    "qps=%s — a load line with silent failures must "
+                    "not enter the trajectory" % (summary["errors"],
+                                                  qps))
+            if tracer is not None:
+                tracer.export_jsonl(args.trace_file)
+                counts = tracer.span_counts()
+                line["trace"] = {
+                    "file": args.trace_file,
+                    "spans": int(sum(counts.values())),
+                    "requests": summary["completed"],
+                }
+            print(json.dumps(line))
+            sys.stdout.flush()
+    finally:
+        fe.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,26 @@ CATALOG = {
         "PADDLE_TPU_METRICS_COLLECTIVES=1 at engine construction; "
         "first step pays one AOT compile for the price)"),
 
+    # -- serving front-end (serving/frontend.py — ISSUE 13) -----------------
+    "serving.http_requests": _m(
+        "counter", "HTTP requests by response status code (200 stream/"
+        "complete, 400 bad request, 404, 429 shed over queue_limit, "
+        "499 client disconnected mid-stream, 503 draining)",
+        labels=("code",)),
+    "serving.shed_total": _m(
+        "counter", "requests shed by admission control (429 over the "
+        "bounded queue + 503 while draining) — the load harness's shed "
+        "rate numerator"),
+    "serving.open_streams": _m(
+        "gauge", "SSE streams currently open (connected clients being "
+        "fed tokens)"),
+    "serving.goodput_tokens": _m(
+        "counter", "generated tokens actually DELIVERED to a connected "
+        "client (streamed events that reached the socket, or the token "
+        "array of a completed non-streaming response) — the goodput "
+        "numerator; tokens computed for a disconnected/cancelled "
+        "request never count"),
+
     # -- training (TrainStep / hapi fit / amp / divergence sentinel) --------
     "train.step_seconds": _m(
         "histogram", "host wall time of one TrainStep call (dispatch; on "

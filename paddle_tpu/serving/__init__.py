@@ -4,10 +4,14 @@ Static-shape paged/slotted KV caches with optional int8 quantization
 (:mod:`.cache`), compile-once batched decode + chunked/bucketed prefill
 + the speculative batched verify (:mod:`.engine`), self-speculative
 prompt-lookup drafting (:mod:`.spec`), Orca-style continuous batching
-(:mod:`.scheduler`), and per-slot greedy/temperature/top-k/top-p
-sampling plus the accept/resample rule with a threaded PRNG key
-(:mod:`.sampling`).  See SERVING.md for the design and the on-chip A/B
-protocol.
+with the overlapped host/device decode loop (:mod:`.scheduler` —
+ISSUE 13: one step in flight, host bookkeeping overlaps device
+compute), per-slot greedy/temperature/top-k/top-p sampling plus the
+accept/resample rule with a threaded PRNG key (:mod:`.sampling`), the
+async streaming HTTP front-end (:mod:`.frontend` — SSE per-token
+streaming, bounded admission, preemption-guard drain), and the Poisson
+load harness (:mod:`.loadgen`).  See SERVING.md for the design and the
+on-chip A/B protocol.
 
 Import discipline: ``models/gpt.py`` imports :mod:`.cache`, so this
 ``__init__`` must not eagerly import :mod:`.engine` (which imports the
@@ -28,16 +32,19 @@ __all__ = [
     "PagePoolExhausted", "is_cache_view", "quantize_kv", "dequantize_kv",
     "sample", "spec_accept", "propose", "TOP_K_MAX", "DecodeEngine",
     "ContinuousBatchingScheduler", "Request", "RequestResult",
-    "PrefillTask", "generate", "engine_for",
+    "PrefillTask", "InflightDecode", "ServingFrontend", "generate",
+    "engine_for",
 ]
 
 _LAZY = {
     "DecodeEngine": ("paddle_tpu.serving.engine", "DecodeEngine"),
+    "InflightDecode": ("paddle_tpu.serving.engine", "InflightDecode"),
     "PrefillTask": ("paddle_tpu.serving.engine", "PrefillTask"),
     "ContinuousBatchingScheduler": ("paddle_tpu.serving.scheduler",
                                     "ContinuousBatchingScheduler"),
     "Request": ("paddle_tpu.serving.scheduler", "Request"),
     "RequestResult": ("paddle_tpu.serving.scheduler", "RequestResult"),
+    "ServingFrontend": ("paddle_tpu.serving.frontend", "ServingFrontend"),
 }
 
 

@@ -124,8 +124,8 @@ from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
 from .pages import PageAllocator, PagePoolExhausted
 from .sampling import TOP_K_MAX, sample, spec_accept
 
-__all__ = ["DecodeEngine", "PagePoolExhausted", "PrefillTask",
-           "prefill_buckets_for"]
+__all__ = ["DecodeEngine", "InflightDecode", "PagePoolExhausted",
+           "PrefillTask", "prefill_buckets_for"]
 
 
 def prefill_buckets_for(max_len, min_bucket=16):
@@ -155,6 +155,31 @@ def _eval_scope(model):
     finally:
         if was_training:
             model.train()
+
+
+@dataclasses.dataclass
+class InflightDecode:
+    """One dispatched, not-yet-consumed decode (or speculative verify)
+    step — the handle the overlapped scheduler loop holds while the
+    device runs the step and the host does the *previous* step's
+    bookkeeping.  Every field except ``active`` is a device array
+    (a future under jax's async dispatch): nothing here has forced a
+    host sync yet.  ``decode_fetch``/``decode_spec_fetch`` consume it —
+    the ONLY blocking point of an engine iteration."""
+    kind: str                             # "decode" | "spec"
+    active: "np.ndarray"                  # dispatch-time mask (host copy)
+    tok: object = None                    # (S,) int32 next tokens (decode)
+    emitted: object = None                # (S, k+1) int32 (spec)
+    counts: object = None                 # (S,) int32 accepted+1 (spec)
+    logits: object = None                 # last-position logits
+    qerr: object = None                   # opt-in quant-error scalar
+    paged_rows: int = 0                   # dispatch-time mapped-rows
+    consumed: bool = False                # set by the fetch
+    slot_epoch: object = None             # per-slot free-epoch at
+                                          # dispatch (spec only): the
+                                          # fetch advances the length
+                                          # mirror ONLY for lanes not
+                                          # freed/readmitted since
 
 
 @dataclasses.dataclass
@@ -515,6 +540,11 @@ class DecodeEngine:
                                     self.max_pages, self.page_size,
                                     tracer=self._tracer)
         self._len_host = np.zeros((self.num_slots,), np.int64)
+        # bumped by free_slot(): a speculative verify step consumed
+        # AFTER its lane was freed (the overlapped loop's overshoot
+        # step) must not advance the zeroed mirror — the in-program
+        # advance landed in pages that free_slot already reclaimed
+        self._slot_epoch = np.zeros((self.num_slots,), np.int64)
         self.cache = PagedKVCache.create(
             self.num_pages, self._layers, self.page_size, self._heads,
             self._head_dim, self.num_slots, self.max_pages,
@@ -840,6 +870,7 @@ class DecodeEngine:
             return
         self._alloc.free_slot(int(slot))
         self._set_length(int(slot), 0)
+        self._slot_epoch[int(slot)] += 1
         self._m_pool.set(self._alloc.pages_used())
 
     def unshared_pages(self, slot):
@@ -1053,16 +1084,34 @@ class DecodeEngine:
 
     # -- decode ------------------------------------------------------------
 
-    def decode(self, tokens, active, temperature, top_k, top_p,
-               pages_ready=False):
-        """One batched decode step.  All inputs are per-slot host arrays
-        of length ``num_slots``; returns (next_tokens as an np array,
-        logits as a jax device array) — callers ignore entries of
-        inactive slots.  ``pages_ready=True`` skips the per-slot page
-        bookkeeping — for callers (the scheduler) that already ran
-        :meth:`ensure_decode_ready` this step to drive eviction;
-        direct callers keep the default check-and-raise."""
+    def _token_operand(self, tokens):
+        """The decode entries' ``(S, 1)`` token operand.  Host arrays
+        take the PR-5 path; a jax array — the previous step's sampled-
+        token output, threaded back by the overlapped scheduler loop
+        without a host round-trip — is reshaped eagerly.  Single-device
+        jit outputs are UNCOMMITTED in this jax, so both spellings hit
+        the SAME jit cache entry (compile-once holds across the mix —
+        tested); tensor-parallel engines instead commit the host path
+        onto the mesh so it matches the sharded outputs' placement (the
+        PR-11 reset lesson: jit keys on commitment there)."""
+        if isinstance(tokens, jax.Array):
+            return jnp.reshape(tokens, (self.num_slots, 1))
         toks = np.asarray(tokens, np.int32).reshape(self.num_slots, 1)
+        if self.tp > 1:
+            return jax.device_put(toks, self._sh())
+        return jnp.asarray(toks)
+
+    def decode_submit(self, tokens, active, temperature, top_k, top_p,
+                      pages_ready=False) -> InflightDecode:
+        """Dispatch one batched decode step WITHOUT fetching the sampled
+        tokens: the returned :class:`InflightDecode` holds device-array
+        futures only, so the call returns as soon as jax has enqueued
+        the compiled program — the overlapped loop's *dispatch* half.
+        ``tokens`` is a host array of last committed tokens, or a device
+        ``(S,)`` int32 array threaded from the previous step's output.
+        Host-visible bookkeeping that is deterministic at dispatch (the
+        length mirror, the KV read accounting) happens HERE, so a
+        sync ``decode()`` and a submit+fetch pair are byte-equivalent."""
         active_np = np.asarray(active, bool).reshape(self.num_slots)
         if self.paged and not pages_ready:
             blocked = self.ensure_decode_ready(active_np)
@@ -1084,59 +1133,88 @@ class DecodeEngine:
             tok, logits, k, v, ks, vs, lengths, qerr = self._decode(
                 self.state, self.cache.k, self.cache.v,
                 *self._cache_scale_args(), self.cache.lengths, *table,
-                jnp.asarray(toks), jnp.asarray(active_np),
+                self._token_operand(tokens), jnp.asarray(active_np),
                 self._next_key(),
                 jnp.asarray(np.asarray(temperature, np.float32)),
                 jnp.asarray(np.minimum(np.asarray(top_k, np.int32),
                                        self.top_k_max)),
                 jnp.asarray(np.asarray(top_p, np.float32)))
-            self.kv_stats["tokens"] += int(active_np.sum())
-            self.kv_stats["flat_rows"] += self.num_slots * self.max_len
-            if self.paged:
-                self.cache = PagedKVCache(k, v, self._alloc.device_table(),
-                                          lengths, k_scale=ks, v_scale=vs)
-                # mirror the program's finalize exactly: lengths advance
-                # for every active lane but clamp at max_len — a direct
-                # caller keeping a full lane active has its append
-                # dropped in-program, so the mirror must not advance
-                # past it either
-                self._len_host[active_np] += 1
-                np.minimum(self._len_host, self.max_len,
-                           out=self._len_host)
-                self.kv_stats["paged_rows"] += \
-                    self._alloc.mapped_rows_total()
-            else:
-                # the slotted read bound IS the flat slots*max_len sweep
-                self.cache = SlottedKVCache(k, v, lengths,
-                                            k_scale=ks, v_scale=vs)
+        self.kv_stats["tokens"] += int(active_np.sum())
+        self.kv_stats["flat_rows"] += self.num_slots * self.max_len
+        if self.paged:
+            self.cache = PagedKVCache(k, v, self._alloc.device_table(),
+                                      lengths, k_scale=ks, v_scale=vs)
+            # mirror the program's finalize exactly: lengths advance
+            # for every active lane but clamp at max_len — a direct
+            # caller keeping a full lane active has its append
+            # dropped in-program, so the mirror must not advance
+            # past it either.  Dispatch-time: a non-spec step's
+            # advance is deterministic, so the mirror stays exact
+            # even while the step is still in flight.
+            self._len_host[active_np] += 1
+            np.minimum(self._len_host, self.max_len,
+                       out=self._len_host)
+            self.kv_stats["paged_rows"] += \
+                self._alloc.mapped_rows_total()
+        else:
+            # the slotted read bound IS the flat slots*max_len sweep
+            self.cache = SlottedKVCache(k, v, lengths,
+                                        k_scale=ks, v_scale=vs)
         if tr_on:
             self._dispatch_span("engine.decode", self._decode, t0_ns, c0)
-        self._set_quant_err(qerr)
         if self._track_coll:
             # per-step collective bytes over the mesh (opt-in; priced
             # once from the compiled sharded program, then a constant)
             self._m_coll.inc(self._collective_price("serving.decode"))
-        return np.asarray(tok), logits
+        return InflightDecode(kind="decode", active=active_np, tok=tok,
+                              logits=logits, qerr=qerr)
 
-    def decode_spec(self, tokens, drafts, active, temperature, top_k,
-                    top_p, pages_ready=False):
-        """One speculative verify step (paged engines with ``spec_k``).
+    def decode_fetch(self, step: InflightDecode):
+        """Consume a dispatched decode step: the one blocking host sync
+        of an engine iteration.  Returns (next_tokens as an np array,
+        logits as a jax device array)."""
+        if step.kind != "decode":
+            raise ValueError("decode_fetch() consumes decode steps; got "
+                             "a %r step (use decode_spec_fetch)"
+                             % step.kind)
+        step.consumed = True
+        toks = np.asarray(step.tok)
+        self._set_quant_err(step.qerr)
+        return toks, step.logits
 
-        ``tokens``: (num_slots,) last committed token per slot;
-        ``drafts``: (num_slots, spec_k) int32 proposals (see
-        :func:`.spec.propose` — quality moves throughput, never
-        correctness).  Returns ``(emitted, counts, logits)``: emitted
-        (num_slots, spec_k+1) np int32 whose row ``b`` holds
-        ``counts[b]`` usable tokens — the accepted drafts plus one
-        sampled/corrected token; logits (slots, k+1, vocab) stays on
-        device.  Each slot's cache length advanced by ``counts[b]``
-        (committed context; the final emitted token is appended by the
-        NEXT step, exactly like :meth:`decode`)."""
+    def decode(self, tokens, active, temperature, top_k, top_p,
+               pages_ready=False):
+        """One batched decode step.  All inputs are per-slot host arrays
+        of length ``num_slots``; returns (next_tokens as an np array,
+        logits as a jax device array) — callers ignore entries of
+        inactive slots.  ``pages_ready=True`` skips the per-slot page
+        bookkeeping — for callers (the scheduler) that already ran
+        :meth:`ensure_decode_ready` this step to drive eviction;
+        direct callers keep the default check-and-raise.
+
+        This is the synchronous spelling: dispatch + immediate fetch.
+        The overlapped scheduler loop calls the halves directly
+        (:meth:`decode_submit` / :meth:`decode_fetch`) to keep one step
+        in flight."""
+        return self.decode_fetch(self.decode_submit(
+            tokens, active, temperature, top_k, top_p,
+            pages_ready=pages_ready))
+
+    def decode_spec_submit(self, tokens, drafts, active, temperature,
+                           top_k, top_p,
+                           pages_ready=False) -> InflightDecode:
+        """Dispatch one speculative verify step without fetching its
+        results (the overlapped loop's dispatch half — see
+        :meth:`decode_submit`).  Unlike a plain decode, the per-slot
+        advance (``counts``) is data-dependent, so the host length
+        mirror and the spec/KV accounting are deferred to
+        :meth:`decode_spec_fetch` — an overlapped caller must map the
+        append range conservatively (``ensure_decode_ready`` with
+        ``steps`` covering the in-flight step's worst case)."""
         if not self.spec_k:
             raise RuntimeError("decode_spec needs an engine built with "
                                "spec_k > 0")
         S, k = self.num_slots, self.spec_k
-        toks = np.asarray(tokens, np.int32).reshape(S, 1)
         drafts_np = np.asarray(drafts, np.int32).reshape(S, k)
         active_np = np.asarray(active, bool).reshape(S)
         if not pages_ready:
@@ -1146,7 +1224,16 @@ class DecodeEngine:
                     "no free page for slot %d's speculative appends — "
                     "evict a slot (the scheduler does this "
                     "refcount-aware)" % blocked)
-        step_toks = np.concatenate([toks, drafts_np], axis=1)  # (S, k+1)
+        if isinstance(tokens, jax.Array):
+            # device-threaded last committed tokens (overlapped loop)
+            step_toks = jnp.concatenate(
+                [jnp.reshape(tokens, (S, 1)), jnp.asarray(drafts_np)],
+                axis=1)
+        else:
+            toks = np.asarray(tokens, np.int32).reshape(S, 1)
+            step_toks = np.concatenate([toks, drafts_np], axis=1)
+            if self.tp > 1:                     # see _token_operand
+                step_toks = jax.device_put(step_toks, self._sh())
         tr_on = self._tracer.enabled
         if tr_on:
             c0 = self._verify.compile_count
@@ -1169,10 +1256,47 @@ class DecodeEngine:
         if tr_on:
             self._dispatch_span("engine.spec_verify", self._verify,
                                 t0_ns, c0)
-        counts_np = np.asarray(counts, np.int64)
+        if self._track_coll:
+            self._m_coll.inc(
+                self._collective_price("serving.spec_verify"))
+        return InflightDecode(
+            kind="spec", active=active_np, emitted=emitted, counts=counts,
+            logits=logits, qerr=qerr,
+            # dispatch-time read accounting: one mapped-pages sweep
+            # serves every token this step commits
+            paged_rows=self._alloc.mapped_rows_total(),
+            slot_epoch=self._slot_epoch.copy())
+
+    def decode_spec_fetch(self, step: InflightDecode):
+        """Consume a dispatched verify step: fetch ``counts`` (the one
+        blocking sync — ``emitted`` rides the same transfer), advance
+        the host length mirror by the in-program commit, and settle the
+        spec/KV accounting.  Returns ``(emitted, counts, logits)`` as
+        :meth:`decode_spec` documents.
+
+        ``spec_stats`` meter DEVICE work: under the overlapped loop an
+        overshoot verify step dispatched for a since-retired slot still
+        counts here (the program really ran), while the scheduler —
+        correctly — never credits its tokens to the request, so the
+        per-request ``spec_proposed``/``spec_accepted`` pair can run
+        below these totals (sync loop: the two agree exactly)."""
+        if step.kind != "spec":
+            raise ValueError("decode_spec_fetch() consumes spec steps; "
+                             "got a %r step (use decode_fetch)"
+                             % step.kind)
+        step.consumed = True
+        active_np = step.active
+        k = self.spec_k
+        counts_np = np.asarray(step.counts, np.int64)
         # mirror the program's rollback exactly: advance by the
-        # accepted+1 commit, clamped at max_len
-        self._len_host[active_np] += counts_np[active_np]
+        # accepted+1 commit, clamped at max_len — but ONLY for lanes
+        # whose slot was not freed (and possibly readmitted) while the
+        # step was in flight: the overlapped loop's overshoot step must
+        # not resurrect a zeroed mirror entry (its in-program advance
+        # landed in pages free_slot already reclaimed)
+        adv = (active_np & (self._slot_epoch == step.slot_epoch)
+               if step.slot_epoch is not None else active_np)
+        self._len_host[adv] += counts_np[adv]
         np.minimum(self._len_host, self.max_len, out=self._len_host)
         n_active = int(active_np.sum())
         emitted_total = int(counts_np[active_np].sum())
@@ -1189,12 +1313,29 @@ class DecodeEngine:
         if n_active:
             self.kv_stats["flat_rows"] += (self.num_slots * self.max_len
                                            * emitted_total) / n_active
-        self.kv_stats["paged_rows"] += self._alloc.mapped_rows_total()
-        self._set_quant_err(qerr)
-        if self._track_coll:
-            self._m_coll.inc(
-                self._collective_price("serving.spec_verify"))
-        return np.asarray(emitted), counts_np.astype(np.int64), logits
+        self.kv_stats["paged_rows"] += step.paged_rows
+        self._set_quant_err(step.qerr)
+        return (np.asarray(step.emitted), counts_np.astype(np.int64),
+                step.logits)
+
+    def decode_spec(self, tokens, drafts, active, temperature, top_k,
+                    top_p, pages_ready=False):
+        """One speculative verify step (paged engines with ``spec_k``).
+
+        ``tokens``: (num_slots,) last committed token per slot;
+        ``drafts``: (num_slots, spec_k) int32 proposals (see
+        :func:`.spec.propose` — quality moves throughput, never
+        correctness).  Returns ``(emitted, counts, logits)``: emitted
+        (num_slots, spec_k+1) np int32 whose row ``b`` holds
+        ``counts[b]`` usable tokens — the accepted drafts plus one
+        sampled/corrected token; logits (slots, k+1, vocab) stays on
+        device.  Each slot's cache length advanced by ``counts[b]``
+        (committed context; the final emitted token is appended by the
+        NEXT step, exactly like :meth:`decode`).  The synchronous
+        spelling of :meth:`decode_spec_submit` + fetch."""
+        return self.decode_spec_fetch(self.decode_spec_submit(
+            tokens, drafts, active, temperature, top_k, top_p,
+            pages_ready=pages_ready))
 
     def slot_lengths(self):
         """Per-slot valid lengths.  Paged mode serves the host mirror —

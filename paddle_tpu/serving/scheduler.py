@@ -8,6 +8,26 @@ into the freed slots — the batch composition changes between iterations
 while the decode program (fixed shape: all ``num_slots`` lanes every
 step) never recompiles.
 
+**Overlapped host/device loop (ISSUE 13 — the default).**  The loop
+keeps ONE decode step in flight: iteration t dispatches the compiled
+step threading iteration t-1's sampled tokens on DEVICE (jax dispatch
+is async — the only blocking point is the token fetch), then consumes
+t-1, so EOS/budget truncation, drafting, page bookkeeping, admission
+and span/metric emission all overlap the device's compute of step t.
+One-step-stale decisions are reconciled at consume time by IDENTITY:
+a lane is credited only if the same request still occupies it — the
+overshoot token a stale dispatch computed for a since-retired/
+preempted/cancelled slot is discarded, its append lands in pages
+``free_slot`` already reclaimed (length-masked reads keep stale rows
+unreachable), and the host length mirror stays exact.  Greedy output
+is BIT-IDENTICAL to the sync loop (``overlap=False`` /
+``PADDLE_TPU_SERVE_OVERLAP=0``, kept for A/B); page pressure drains
+the in-flight step before evicting.  ``host_gap_seconds`` /
+``decode_steps_total`` expose the structural win the bench reports:
+wall time per step with NO step in flight (the device-starvation
+window) collapses from the whole per-step host budget to true
+pipeline bubbles.
+
 **Chunked prefill (paged engines — the default).**  Admission no longer
 runs the whole prompt in one blocking call: it starts a
 :class:`~.engine.PrefillTask` and each scheduler iteration advances
@@ -108,7 +128,9 @@ class Request:
 class RequestResult:
     rid: int
     tokens: "np.ndarray"                 # generated ids (prompt excluded)
-    finish_reason: str                   # "eos" | "length" | "cache_full"
+    finish_reason: str                   # "eos" | "length" |
+                                         # "cache_full" | "cancelled"
+                                         # (client gone — frontend)
     ttft: float                          # submit -> first token, seconds
     tpot: float                          # mean secs per timed decode step
                                          # (prefill-sampled tokens, incl. a
@@ -130,7 +152,7 @@ class _ActiveSlot:
     __slots__ = ("req", "generated", "submit_t", "first_tok_t", "last_t",
                  "decode_s", "decode_steps", "queue_wait", "prefill_task",
                  "admit_order", "prefix_hit_tokens", "spec_proposed",
-                 "spec_accepted")
+                 "spec_accepted", "cache_len")
 
     def __init__(self, req, submit_t, queue_wait, admit_order,
                  prefill_task=None):
@@ -154,6 +176,15 @@ class _ActiveSlot:
                                   if prefill_task is not None else 0)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # committed cache rows this request holds, mirrored host-side
+        # from what the device programs actually advanced (prefill sets
+        # it to the prompt length; each CONSUMED decode/verify step adds
+        # its in-program advance, clamped at max_len exactly like the
+        # device finalize).  The cache_full retire check reads this —
+        # no per-iteration device fetch, and it stays exact in the
+        # overlapped loop where the engine's dispatch-time mirror runs
+        # one step ahead of consumed truth.
+        self.cache_len = 0
 
     def first_token(self, tok, now):
         self.generated.append(int(tok))
@@ -164,14 +195,67 @@ class _ActiveSlot:
         self.last_t = now
 
 
+class _Inflight:
+    """Scheduler-side record of ONE dispatched, unconsumed decode (or
+    verify) step: the engine's :class:`~.engine.InflightDecode` plus the
+    per-lane occupant identities at dispatch time.  Consume credits a
+    lane ONLY if the same :class:`_ActiveSlot` object still occupies it
+    — a slot retired (EOS/budget/cache-full), preempted, or cancelled
+    after the dispatch simply has its overshoot token(s) discarded,
+    which is the whole one-step-stale reconciliation rule."""
+    __slots__ = ("rec", "lane_acts", "t0_ns")
+
+    def __init__(self, rec, lane_acts, t0_ns):
+        self.rec = rec
+        self.lane_acts = lane_acts
+        self.t0_ns = t0_ns
+
+
 class ContinuousBatchingScheduler:
     # page-pressure evictions per request before the scheduler stops
     # requeueing it and finishes it "cache_full" — bounds wasted
     # recompute and keeps run()'s termination argument trivial
     max_preemptions = 3
 
-    def __init__(self, engine, tracer=None):
+    def __init__(self, engine, tracer=None, overlap=None, on_token=None,
+                 on_finish=None):
         self.engine = engine
+        # -- overlapped host/device decode loop (ISSUE 13) -----------------
+        # overlap=True (the default; env escape hatch
+        # PADDLE_TPU_SERVE_OVERLAP=0) keeps ONE decode step in flight:
+        # each iteration dispatches step t (threading step t-1's sampled
+        # tokens on DEVICE — jax dispatch is async) and only then blocks
+        # on step t-1's token fetch, so host bookkeeping for step t-1
+        # overlaps device compute for step t.  Host-visible effects lag
+        # one step; consume reconciles by crediting a lane only when the
+        # same request still occupies it (see _Inflight).  Greedy output
+        # is BIT-IDENTICAL to the sync loop; seeded temperature>0
+        # sampling is reproducible within a mode but not across modes
+        # (overshoot steps consume threaded keys).
+        import os as _os
+        if overlap is None:
+            overlap = _os.environ.get("PADDLE_TPU_SERVE_OVERLAP",
+                                      "1") != "0"
+        self.overlap = bool(overlap)
+        self._inflight: Optional[_Inflight] = None
+        self._drained_n = 0            # tokens consumed by implicit
+                                       # drains (page pressure / cancel)
+                                       # since step() last collected
+        # host-gap accounting (the bench's A/B line): wall time during
+        # which NO decode step was dispatched-and-unconsumed — the only
+        # windows where the device can be token-starved by the host.
+        # The sync loop pays the whole consume-to-dispatch host window
+        # per step; the overlapped loop pays only true pipeline bubbles.
+        self.host_gap_seconds = 0.0
+        self.decode_steps_total = 0
+        self._outstanding = 0          # dispatched, unconsumed steps
+        self._last_fetch_ns = None
+        self._last_step_end_ns = None
+        # streaming hooks (the async front-end): called on the scheduler
+        # thread — on_token(rid, [ids...]) per appended run (first
+        # tokens included), on_finish(RequestResult) at retirement
+        self._on_token = on_token
+        self._on_finish = on_finish
         self.waiting: deque = deque()
         self.slots: List[Optional[_ActiveSlot]] = [None] * engine.num_slots
         self.finished: Dict[int, RequestResult] = {}
@@ -284,13 +368,17 @@ class ContinuousBatchingScheduler:
             self._m_ttft.observe(ttft)
         if act.decode_steps:
             self._m_tpot.observe(tpot)
+        if self._on_finish is not None:
+            self._on_finish(self.finished[act.req.rid])
 
-    def _check_finished(self, idx: int, lengths):
-        """Retire the slot if its latest token ended the request.
-        ``lengths`` is the post-step per-slot lengths — fetched ONCE per
-        scheduler iteration by the caller (paged engines serve a host
-        mirror; a per-slot device fetch here would be a device->host
-        round-trip on the decode hot path, per slot per token)."""
+    def _check_finished(self, idx: int, lengths=None):
+        """Retire the slot if its latest token ended the request.  The
+        cache-full check reads the slot's host-tracked COMMITTED length
+        (``act.cache_len`` — what consumed device programs actually
+        advanced): no device fetch on the decode hot path, and exact in
+        the overlapped loop too, where the engine's dispatch-time mirror
+        runs one step ahead of consumed truth.  ``lengths`` is accepted
+        for backward compatibility and ignored."""
         act = self.slots[idx]
         req = act.req
         if not act.generated:
@@ -300,7 +388,7 @@ class ContinuousBatchingScheduler:
             self._finish(idx, "eos")
         elif len(act.generated) >= req.max_new_tokens:
             self._finish(idx, "length")
-        elif int(lengths[idx]) >= self.engine.max_len:
+        elif act.cache_len >= self.engine.max_len:
             # no room for another append — retire rather than overflow
             self._finish(idx, "cache_full")
 
@@ -439,9 +527,11 @@ class ContinuousBatchingScheduler:
                 sp.end()
                 root.event("first_token")
                 act = _ActiveSlot(req, submit_t, queue_wait, order)
+                act.cache_len = int(req.prompt.size)
                 act.first_token(tok, time.perf_counter())
                 self.slots[idx] = act
-                self._check_finished(idx, self.engine.slot_lengths())
+                self._notify_tokens(req.rid, act.generated[-1:])
+                self._check_finished(idx)
             n += 1
         if n:
             self._m_queue_depth.set(len(self.waiting))
@@ -475,6 +565,12 @@ class ContinuousBatchingScheduler:
                     done = self.engine.prefill_step(task)
                     break
                 except PagePoolExhausted:
+                    # drain any in-flight decode step FIRST: its
+                    # retirements may free enough pages, and a preempted
+                    # victim must never have an undrained step (the
+                    # parked token list would then lag the device)
+                    if self._drain_inflight():
+                        continue
                     if not self._evict_for_pages(idx):
                         done = None    # requester itself was retired
                         break
@@ -486,88 +582,179 @@ class ContinuousBatchingScheduler:
             n += 1
             if done:
                 act.prefill_task = None
+                act.cache_len = int(task.ids.size)
                 if act.first_tok_t is None:
                     root.event("first_token")
                 act.first_token(task.first_token, now)
-                self._check_finished(idx, self.engine.slot_lengths())
+                self._notify_tokens(act.req.rid, act.generated[-1:])
+                self._check_finished(idx)
         return n
 
     # -- decode ------------------------------------------------------------
 
-    def decode_once(self) -> int:
-        """One batched decode (or speculative verify) iteration over the
-        active (fully-prefilled) slots; returns the number of tokens
-        appended to live requests."""
-        def active_mask():
-            return [a is not None and a.prefill_task is None
-                    for a in self.slots]
+    def _active_mask(self):
+        return [a is not None and a.prefill_task is None
+                for a in self.slots]
 
+    def _notify_tokens(self, rid, toks):
+        if self._on_token is not None and toks:
+            self._on_token(rid, [int(t) for t in toks])
+
+    def _dispatch_decode(self) -> Optional[_Inflight]:
+        """Dispatch ONE batched decode (or speculative verify) step over
+        the active, fully-prefilled slots — without consuming it.  When
+        an unconsumed step is in flight (the overlapped loop), its
+        device-side sampled tokens are threaded straight into this
+        dispatch (no host round-trip); lanes that joined since (fresh
+        prefills) merge their host-known first token in with one eager
+        ``where``.  Page pressure drains the in-flight step FIRST (its
+        retirements may free pages, and an eviction victim must never
+        carry an undrained step), then evicts refcount-aware.  Returns
+        the in-flight record, or None when nothing is active."""
         spec_k = int(getattr(self.engine, "spec_k", 0))
-        active = active_mask()
+        active = self._active_mask()
         if not any(active):
-            return 0
+            return None
         if self.engine.paged:
             # pre-step page bookkeeping: every append (k+1 of them per
-            # slot for a verify step) needs a mapped private page;
-            # pool-dry evicts the max-unshared victim
+            # slot for a verify step) needs a mapped private page.  A
+            # verify step's advance is data-dependent, so while one is
+            # unconsumed the engine mirror lags it — cover BOTH steps'
+            # worst case (non-spec steps advance the mirror at dispatch:
+            # no slack needed).
             while True:
+                slack = (spec_k + 1
+                         if spec_k and self._inflight is not None else 0)
                 blocked = self.engine.ensure_decode_ready(
-                    active, steps=spec_k + 1)
+                    active, steps=spec_k + 1 + slack)
                 if blocked is None:
                     break
-                self._evict_for_pages(blocked)
-                active = active_mask()
+                if self._drain_inflight():
+                    active = self._active_mask()
+                else:
+                    self._evict_for_pages(blocked)
+                    active = self._active_mask()
                 if not any(active):
-                    return 0
+                    return None
         S = self.engine.num_slots
         tokens = np.zeros((S,), np.int32)
+        fresh = np.zeros((S,), bool)
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.ones((S,), np.float32)
         drafts = np.zeros((S, max(spec_k, 1)), np.int32)
+        prev = self._inflight
+        if prev is not None and prev.rec.consumed:
+            prev = None
         for i, act in enumerate(self.slots):
             if not active[i]:
                 continue
-            tokens[i] = act.generated[-1]
+            if (prev is None or not prev.rec.active[i]
+                    or prev.lane_acts[i] is not act):
+                # no in-flight step holds this lane's next token: feed
+                # the host-known last token (first dispatch, a fresh
+                # prefill, or a drained pipeline)
+                tokens[i] = act.generated[-1]
+                fresh[i] = True
             temps[i] = act.req.temperature
             top_ks[i] = act.req.top_k
             top_ps[i] = act.req.top_p
             if spec_k:
                 # self-speculative prompt-lookup draft over the slot's
                 # OWN history — host-side, zero model FLOPs; a miss just
-                # pads (the verify step then emits one token, like decode)
+                # pads (the verify step then emits one token, like
+                # decode).  With a step in flight the history lags by
+                # its unconsumed emit — draft quality moves throughput,
+                # never correctness (greedy accept is history-free).
                 hist = np.concatenate(
                     [act.req.prompt,
                      np.asarray(act.generated, np.int32)])
                 drafts[i], _hit = _propose_draft(
                     hist, spec_k, getattr(self.engine, "spec_ngram", 3))
-        # ONE clock read per boundary, in ns: the step time feeds the
-        # histogram AND stamps every involved request's trace span with
-        # the SAME interval, so trace-report TPOT reproduces the metric
+        if prev is not None and not bool(fresh.all()):
+            # thread the in-flight step's sampled tokens on DEVICE: for
+            # a verify step the last committed token of lane i is
+            # emitted[i, counts[i]-1] (an eager gather on futures)
+            import jax.numpy as jnp
+            if prev.rec.kind == "spec":
+                prev_last = jnp.take_along_axis(
+                    prev.rec.emitted,
+                    jnp.maximum(prev.rec.counts, 1)[:, None] - 1,
+                    axis=1)[:, 0]
+            else:
+                prev_last = prev.rec.tok
+            tok_in = (jnp.where(jnp.asarray(fresh), jnp.asarray(tokens),
+                                prev_last)
+                      if bool(fresh.any()) else prev_last)
+        else:
+            tok_in = tokens
+        # host-gap accounting: with nothing in flight, the whole window
+        # since the last fetch starved the device (the sync loop pays
+        # this every step; the overlapped loop only on true bubbles)
         t0_ns = time.perf_counter_ns()
+        if self._outstanding == 0 and self._last_fetch_ns is not None:
+            self.host_gap_seconds += (t0_ns - self._last_fetch_ns) * 1e-9
         if spec_k:
-            emitted, counts, _logits = self.engine.decode_spec(
-                tokens, drafts, active, temps, top_ks, top_ps,
+            rec = self.engine.decode_spec_submit(
+                tok_in, drafts, active, temps, top_ks, top_ps,
                 pages_ready=True)
         else:
-            next_tok, _logits = self.engine.decode(tokens, active, temps,
-                                                   top_ks, top_ps,
-                                                   pages_ready=True)
+            rec = self.engine.decode_submit(tok_in, active, temps,
+                                            top_ks, top_ps,
+                                            pages_ready=True)
+        self._outstanding += 1
+        return _Inflight(rec=rec,
+                         lane_acts=[self.slots[i] if active[i] else None
+                                    for i in range(S)],
+                         t0_ns=t0_ns)
+
+    def _consume_inflight(self, infl: _Inflight) -> int:
+        """Consume one dispatched step: fetch its sampled tokens (the
+        only blocking device sync of an iteration) and run the host-side
+        bookkeeping — extend token lists, truncate at EOS/budget, retire
+        finished slots, notify streams.  A lane is credited ONLY if the
+        same request still occupies it (see :class:`_Inflight`): the
+        overshoot token a one-step-stale dispatch computed for a
+        since-retired slot is discarded here, and its cache rows are
+        reclaimed by the retire's ``free_slot`` — the host length mirror
+        stays exact without a rollback program."""
+        rec = infl.rec
+        spec_k = self.engine.spec_k if rec.kind == "spec" else 0
+        if rec.kind == "spec":
+            emitted, counts, _logits = self.engine.decode_spec_fetch(rec)
+        else:
+            next_tok, _logits = self.engine.decode_fetch(rec)
         t1_ns = time.perf_counter_ns()
+        self._outstanding -= 1
+        self._last_fetch_ns = t1_ns
+        self.decode_steps_total += 1
+        # the step interval: clipped at the previous consume so
+        # consecutive overlapped steps never double-charge wall time
+        # (per-request decode_s must sum to drain wall, not 2x it);
+        # feeds the histogram AND every involved request's trace span,
+        # so trace-report TPOT reproduces the metric exactly
+        t0_ns = (infl.t0_ns if self._last_step_end_ns is None
+                 else max(infl.t0_ns, self._last_step_end_ns))
+        self._last_step_end_ns = t1_ns
         step_s = (t1_ns - t0_ns) * 1e-9
         t1 = t1_ns * 1e-9                      # last_t bookkeeping
-        lengths = self.engine.slot_lengths()   # ONE fetch per step
         n = 0
         spec_prop = spec_acc = 0               # per-ITERATION counter incs
         for i, act in enumerate(self.slots):
-            if not active[i]:
-                continue
+            if (not rec.active[i] or act is None
+                    or infl.lane_acts[i] is not act):
+                continue               # retired/preempted/cancelled since
             if spec_k:
-                emit = [int(t) for t in emitted[i, :int(counts[i])]]
+                raw = int(counts[i])
+                emit = [int(t) for t in emitted[i, :raw]]
                 act.spec_proposed += spec_k
                 act.spec_accepted += len(emit) - 1
                 spec_prop += spec_k
                 spec_acc += len(emit) - 1
+                # mirror the program's finalize: the device committed
+                # `raw` rows for this lane (clamped in-program)
+                act.cache_len = min(act.cache_len + raw,
+                                    self.engine.max_len)
                 # truncate at the budget and at EOS — both retire the
                 # slot in _check_finished, so a truncated host token
                 # list never belongs to a live (still-decoding) slot
@@ -579,11 +766,14 @@ class ContinuousBatchingScheduler:
                         emit = emit[:emit.index(eos) + 1]
             else:
                 emit = [int(next_tok[i])]
+                act.cache_len = min(act.cache_len + 1,
+                                    self.engine.max_len)
             act.generated.extend(emit)
             act.decode_s += step_s
             act.decode_steps += len(emit)   # TPOT = secs per token
             act.last_t = t1
             n += len(emit)
+            self._notify_tokens(act.req.rid, emit)
             if self._tron:
                 # one span per involved request per iteration, stamped
                 # with the shared step interval; `tokens` is the
@@ -593,7 +783,7 @@ class ContinuousBatchingScheduler:
                     "spec_verify" if spec_k else "decode", t0_ns, t1_ns,
                     parent=self._req_spans.get(act.req.rid),
                     tokens=len(emit))
-            self._check_finished(i, lengths)
+            self._check_finished(i)
         # per-ITERATION metrics (not per token): one histogram observe,
         # one counter inc, one gauge set per batched step
         self._m_decode_step.observe(step_s)
@@ -604,13 +794,61 @@ class ContinuousBatchingScheduler:
         self._m_occupancy.set(sum(a is not None for a in self.slots))
         return n
 
+    def _drain_inflight(self) -> bool:
+        """Consume the in-flight step now, if any (page pressure, a
+        cancel, or an external caller needing consistent host state).
+        Tokens it credited land in ``self._drained_n`` for step() to
+        collect; returns whether a step was drained."""
+        infl = self._inflight
+        if infl is None or infl.rec.consumed:
+            self._inflight = None
+            return False
+        self._inflight = None
+        self._drained_n += self._consume_inflight(infl)
+        return True
+
+    def decode_once(self) -> int:
+        """One SYNCHRONOUS batched decode (or speculative verify)
+        iteration over the active slots: dispatch + immediate consume
+        (the ``overlap=False`` loop, and the direct-caller API).  Any
+        leftover overlapped step is drained first; returns the number
+        of tokens appended to live requests by THIS iteration."""
+        self._drain_inflight()
+        infl = self._dispatch_decode()
+        if infl is None:
+            return 0
+        return self._consume_inflight(infl)
+
     def step(self) -> int:
         """One scheduler iteration: admit into free slots, advance every
         admitting slot by one prefill chunk, then one batched decode.
-        Returns decode tokens produced (prefill first-tokens excluded)."""
+        Overlapped (the default): dispatch step t BEFORE consuming step
+        t-1, so the host bookkeeping below overlaps the device's compute
+        for step t.  Returns decode tokens produced this iteration
+        (prefill first-tokens excluded)."""
+        self._drained_n = 0
         self.admit()
         self.prefill_once()
-        n = self.decode_once()
+        if self.overlap:
+            prev = self._inflight
+            nxt = self._dispatch_decode()   # threads prev's device toks
+            self._inflight = nxt
+            n = 0
+            if prev is not None and not prev.rec.consumed:
+                n = self._consume_inflight(prev)
+        else:
+            n = self.decode_once()
+        n += self._drained_n
+        self._drained_n = 0
+        if (self._inflight is None and not self.waiting
+                and not any(a is not None for a in self.slots)):
+            # pipeline fully idle with NO backlog (drain end / between
+            # traffic): the window until the next dispatch is ARRIVAL
+            # time, not host work — charging it would book a load
+            # test's Poisson gaps as host gap.  A drained pipeline
+            # with requests still waiting keeps the clock: that window
+            # IS host-side serialization (admission + prefill).
+            self._last_fetch_ns = None
         # HBM ledger sample at the ITERATION boundary (host-side, after
         # the batched step dispatched — never inside a trace).  One
         # module-global None check while the ledger is disarmed, the
@@ -623,12 +861,81 @@ class ContinuousBatchingScheduler:
         terminates: with work pending, admit() either fills a free slot
         or all slots are occupied; prefill_once() advances every
         admitting prompt by one (finite) chunk — evicting on page
-        pressure rather than blocking — and decode_once() appends a
-        token to every active request, each of which is finite
-        (max_new_tokens / max_len eviction).  Preemption cannot spin
-        forever: each request is requeued at most ``max_preemptions``
-        times before it finishes "cache_full", and a requester that is
-        the sole occupant is finished, never requeued."""
-        while self.waiting or any(a is not None for a in self.slots):
+        pressure rather than blocking — and each consumed decode step
+        appends a token to every credited request, each of which is
+        finite (max_new_tokens / max_len eviction).  Preemption cannot
+        spin forever: each request is requeued at most
+        ``max_preemptions`` times before it finishes "cache_full", and a
+        requester that is the sole occupant is finished, never requeued.
+        The overlapped loop adds one tail iteration that only consumes
+        the final in-flight step."""
+        while (self.waiting or any(a is not None for a in self.slots)
+               or self._inflight is not None):
             self.step()
         return self.finished
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a request (a disconnected streaming client): frees its
+        slot AND its pages immediately (refcount-exact — a shared prefix
+        page only drops a refcount), or removes it from the waiting
+        queue / the preemption-parking area.  Tokens generated so far
+        ride the ``"cancelled"`` :class:`RequestResult`.  Returns False
+        when the rid is unknown or already finished.  Must run on the
+        scheduler's thread (the front-end routes cancels through its
+        command queue)."""
+        if rid in self.finished:
+            return False
+        # an in-flight step may hold a lane for this request: drain
+        # first so the consume's identity check stays meaningful and
+        # the engine's spec length mirror (advanced at fetch by the
+        # DISPATCH mask) never credits a freed lane
+        self._drain_inflight()
+        if rid in self.finished:       # the drain itself retired it
+            return True
+        for idx, act in enumerate(self.slots):
+            if act is not None and act.req.rid == rid:
+                self._finish(idx, "cancelled")
+                return True
+        for req in list(self.waiting):
+            if req.rid == rid:
+                self.waiting.remove(req)
+                self._m_queue_depth.set(len(self.waiting))
+                parked = self._preempted.pop(rid, None)
+                self._submit_t.pop(rid, None)
+                self._preempt_count.pop(rid, None)
+                got_first = (parked is not None
+                             and parked.first_tok_t is not None)
+                res = RequestResult(
+                    rid=rid,
+                    tokens=np.asarray(
+                        parked.generated if parked is not None else [],
+                        np.int32),
+                    finish_reason="cancelled",
+                    ttft=((parked.first_tok_t - parked.submit_t)
+                          if got_first else 0.0),
+                    tpot=((parked.decode_s / parked.decode_steps)
+                          if parked is not None and parked.decode_steps
+                          else 0.0),
+                    queue_wait=(parked.queue_wait
+                                if parked is not None else 0.0),
+                    prefix_hit_tokens=(parked.prefix_hit_tokens
+                                       if parked is not None else 0),
+                    trace_id=self._trace_ids.pop(rid, 0))
+                self.finished[rid] = res
+                ws = self._wait_spans.pop(rid, None)
+                if ws is not None:
+                    ws.end()
+                self._req_spans.pop(rid, _tracing.NOOP_SPAN).end(
+                    reason="cancelled", tokens=int(res.tokens.size))
+                self._m_finished.labels(reason="cancelled").inc()
+                if self._on_finish is not None:
+                    self._on_finish(res)
+                return True
+        return False
+
+    def request_span(self, rid: int):
+        """The live root span of an unfinished request (the front-end
+        parents its ``http`` span here so the trace tree stays
+        connected); the no-op span when tracing is off or the request
+        already retired."""
+        return self._req_spans.get(rid, _tracing.NOOP_SPAN)

@@ -1118,3 +1118,116 @@ def test_flight_dump_deferred_out_of_signal_frame(tmp_path):
     finally:
         flight.disable()
         g.clear()
+
+
+# ==========================================================================
+# serving front-end chaos (ISSUE 13: serve.stream + guard-fire drain)
+# ==========================================================================
+
+def _serve_frontend(queue_limit=8, guard=None):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+    from paddle_tpu.serving.frontend import ServingFrontend
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = DecodeEngine(model, num_slots=2, max_len=64, seed=0,
+                          page_size=8)
+    fe = ServingFrontend(engine, queue_limit=queue_limit, guard=guard)
+    fe.start()
+    return fe, engine
+
+
+def _serve_post(fe, payload, read_all=True):
+    import socket as _socket
+    s = _socket.create_connection((fe.host, fe.port), timeout=60)
+    body = json.dumps(payload).encode()
+    s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: c\r\n"
+              b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    if not read_all:
+        return s
+    buf = b""
+    while True:
+        b = s.recv(65536)
+        if not b:
+            break
+        buf += b
+    s.close()
+    return buf
+
+
+def test_serve_stream_site_declared():
+    """Importing the front-end registers its chaos site (the registry
+    mirrors the instrumentation, ROBUSTNESS.md discipline)."""
+    import paddle_tpu.serving.frontend  # noqa: F401
+    assert "serve.stream" in fp.SITES
+
+
+@pytest.mark.slow
+def test_injected_stream_reset_cancels_and_frees_pages():
+    """A SocketReset injected at the serve.stream site (= the client
+    vanished mid-stream) must cancel the request, free its slot AND its
+    pages refcount-exactly (no pool leak), and leave the engine
+    serviceable — the NEXT request completes normally."""
+    fe, engine = _serve_frontend()
+    try:
+        plan = fp.FaultPlan(seed=0).inject(
+            "serve.stream", fp.SocketReset(), at=2)
+        with fp.chaos(plan):
+            raw = _serve_post(fe, {"prompt": [5, 6, 7, 8],
+                                   "max_new_tokens": 40,
+                                   "temperature": 0.0})
+        plan.assert_all_fired()
+        # the stream was cut mid-flight: no done event reached us
+        assert b'"done": true' not in raw
+        deadline = time.time() + 30
+        while time.time() < deadline and engine._alloc.pages_used():
+            time.sleep(0.02)
+        assert engine._alloc.pages_used() == 0, "page leak after reset"
+        res = list(fe.scheduler.finished.values())
+        assert res and res[0].finish_reason == "cancelled"
+        # the engine survived: a fresh request runs to completion
+        raw2 = _serve_post(fe, {"prompt": [5, 6, 7, 8],
+                                "max_new_tokens": 3,
+                                "temperature": 0.0})
+        assert b'"done": true' in raw2
+        assert engine.decode_compile_count == 1
+    finally:
+        fe.stop()
+    assert engine._alloc.pages_used() == 0
+
+
+@pytest.mark.slow
+def test_preempt_during_serve_requeues_not_drops():
+    """The chaos Preempt action (simulated SIGTERM) fires while requests
+    are in flight: the front-end drains — every accepted request
+    finishes with its FULL token stream (requeue-not-drop is the
+    scheduler's job under pressure; the drain's job is to never cut a
+    stream) — and new requests shed 503."""
+    guard = PreemptionGuard(install=False)
+    fe, engine = _serve_frontend(guard=guard)
+    try:
+        s = _serve_post(fe, {"prompt": [9, 8, 7], "max_new_tokens": 10,
+                             "temperature": 0.0}, read_all=False)
+        plan = fp.FaultPlan(seed=0).inject("train.epoch", fp.Preempt(),
+                                           at=0)
+        with fp.chaos(plan):
+            fp.faultpoint("train.epoch")   # any site: Preempt flips guards
+        plan.assert_all_fired()
+        buf = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+        s.close()
+        assert b'"finish_reason": "length"' in buf
+        assert buf.count(b"data: {\"tokens\"") == 10   # full stream
+        assert fe.wait_drained(30)
+        raw = _serve_post(fe, {"prompt": [1], "max_new_tokens": 1})
+        assert b"503" in raw.split(b"\r\n")[0]
+    finally:
+        guard.clear()
+        fe.stop()

@@ -235,6 +235,39 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     tp_eng.decode([tok, 0], [True, False], [0.0, 0.0], [0, 0],
                   [1.0, 1.0])
 
+    # -- serving E: the async front-end (ISSUE 13) — one shed (429 +
+    # shed_total) then one real streamed completion over HTTP (200,
+    # open_streams, goodput_tokens) through the live asyncio server
+    import json as _json
+    import socket as _socket
+
+    from paddle_tpu.serving.frontend import ServingFrontend
+    paged.reset()
+    fe = ServingFrontend(paged, queue_limit=0)
+    fe.start()
+    try:
+        def _post(payload):
+            s = _socket.create_connection((fe.host, fe.port), timeout=60)
+            body = _json.dumps(payload).encode()
+            s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: r\r\n"
+                      b"Content-Length: %d\r\n\r\n" % len(body) + body)
+            buf = b""
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                buf += b
+            s.close()
+            return buf
+        raw = _post({"prompt": [1, 2, 3], "max_new_tokens": 2})
+        assert b"429" in raw.split(b"\r\n")[0]     # shed over the bound
+        fe.queue_limit = 8
+        raw = _post({"prompt": [1, 2, 3], "max_new_tokens": 2,
+                     "temperature": 0.0})
+        assert b'"done": true' in raw              # streamed completion
+    finally:
+        fe.stop()
+
     # -- training: TrainStep (+ opt-in grad norm) and the hapi fit loop ----
     from paddle_tpu import hapi, nn
     from paddle_tpu.jit import TrainStep
@@ -957,10 +990,117 @@ def test_trajectory_mode_accepts_committed_repo_files():
     import pathlib
     root = pathlib.Path(__file__).resolve().parent.parent
     paths = sorted(glob.glob(str(root / "BENCH_r*.json"))
-                   + glob.glob(str(root / "BENCH_decode_*.json")))
+                   + glob.glob(str(root / "BENCH_decode_*.json"))
+                   + glob.glob(str(root / "BENCH_serve_*.json")))
     assert paths
     assert bs.check_trajectory(paths) == [], \
         "committed BENCH_* trajectory violates its own gate"
+
+
+# -- BENCH_serve schema + trajectory gates (ISSUE 13) -----------------------
+
+def _serve_line(value, backend, qps=8.0, mix="short", ttft_p99=50.0,
+                overlap=True, **over):
+    line = {"metric": "serve_goodput_tokens_per_sec", "value": value,
+            "unit": "tok/s", "qps": qps, "mix": mix,
+            "cache_layout": "paged", "kv_dtype": "bf16", "spec": 0,
+            "tp": 1, "overlap": overlap,
+            "ttft_p50_ms": 10.0, "ttft_p99_ms": ttft_p99,
+            "tpot_p50_ms": 2.0, "tpot_p99_ms": 4.0, "shed_rate": 0.0,
+            "metrics": {"histograms": {},
+                        "compile_counts": {"serving.decode": 1}},
+            "config": {"backend": backend, "model": "tiny_d64"}}
+    line.update(over)
+    return line
+
+
+def _serve_entry(tmp_path, name, *a, **kw):
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": 1, "cmd": "bench_serve", "rc": 0,
+                             "parsed": _serve_line(*a, **kw)}))
+    return str(p)
+
+
+def test_serve_line_schema():
+    bs = _bench_schema()
+    bs.validate_line(_serve_line(100.0, "cpu"), "<t>",
+                     ["serving.decode"])
+    import pytest as _pt
+    for mutate in (
+        lambda l: l.pop("ttft_p99_ms"),            # missing p99
+        lambda l: l.pop("mix"),                    # missing mix
+        lambda l: l.pop("qps"),                    # missing qps
+        lambda l: l.update(shed_rate=1.5),         # impossible rate
+        lambda l: l.update(qps=0),                 # zero offered rate
+        lambda l: l.update(ttft_p50_ms=99.0),      # p50 > p99
+    ):
+        bad = _serve_line(100.0, "cpu")
+        mutate(bad)
+        with _pt.raises(bs.SchemaError):
+            bs.validate_line(bad, "<t>")
+    # decode lines are untouched by the serve field requirements
+    bs.validate_line({"metric": "decode_tokens_per_sec", "value": 1.0,
+                      "unit": "tok/s"}, "<t>")
+
+
+def test_serve_trajectory_gates_goodput_and_p99_like_for_like(tmp_path):
+    """Serve cursors key on (qps, mix) on top of the decode axes: a
+    qps=16 line never gates against qps=4; a like-for-like goodput drop
+    OR p99-TTFT growth fails; CPU lines never gate."""
+    bs = _bench_schema()
+    ok = [
+        _serve_entry(tmp_path, "BENCH_serve_r01.json", 100.0, "tpu",
+                     qps=4.0),
+        _serve_entry(tmp_path, "BENCH_serve_r02.json", 60.0, "tpu",
+                     qps=16.0, ttft_p99=200.0),   # saturated point: its
+        _serve_entry(tmp_path, "BENCH_serve_r03.json", 99.0, "tpu",
+                     qps=4.0),                    # own cursor, no fail
+    ]
+    assert bs.check_trajectory(ok) == []
+    # like-for-like goodput drop fails, anchored to the SAME (qps, mix)
+    drop = ok + [_serve_entry(tmp_path, "BENCH_serve_r04.json", 80.0,
+                              "tpu", qps=4.0)]
+    fails = bs.check_trajectory(drop)
+    assert len(fails) == 1 and "BENCH_serve_r03" in fails[0]
+    # p99-TTFT growth fails even with goodput held
+    tail = ok + [_serve_entry(tmp_path, "BENCH_serve_r05.json", 99.5,
+                              "tpu", qps=4.0, ttft_p99=60.0)]
+    fails = bs.check_trajectory(tail)
+    assert len(fails) == 1 and "p99 TTFT" in fails[0]
+    # CPU smoke points never perf-gate
+    cpu = [_serve_entry(tmp_path, "BENCH_serve_s1.json", 100.0, "cpu"),
+           _serve_entry(tmp_path, "BENCH_serve_s2.json", 10.0, "cpu")]
+    assert bs.check_trajectory(cpu) == []
+    # a different mix is a different cursor
+    mixes = [_serve_entry(tmp_path, "BENCH_serve_m1.json", 100.0, "tpu",
+                          mix="short"),
+             _serve_entry(tmp_path, "BENCH_serve_m2.json", 40.0, "tpu",
+                          mix="long")]
+    assert bs.check_trajectory(mixes) == []
+
+
+def test_trajectory_cursor_keys_on_overlap(tmp_path):
+    """ISSUE-13 decode axis: a sync-loop (--overlap off) A/B line is
+    legitimately slower than the overlapped default — each keeps its
+    own cursor; legacy lines (no overlap field) keep theirs."""
+    bs = _bench_schema()
+    def entry(name, value, overlap):
+        p = tmp_path / name
+        line = {"metric": "decode_tokens_per_sec", "value": value,
+                "unit": "tok/s", "cache_layout": "paged",
+                "overlap": overlap,
+                "config": {"backend": "tpu", "model": "tiny"}}
+        p.write_text(json.dumps({"n": 1, "cmd": "b", "rc": 0,
+                                 "parsed": line}))
+        return str(p)
+    mixed = [entry("BENCH_decode_o1.json", 1000.0, True),
+             entry("BENCH_decode_o2.json", 800.0, False),
+             entry("BENCH_decode_o3.json", 1005.0, True)]
+    assert bs.check_trajectory(mixed) == []
+    # a like-for-like drop on the overlapped leg still fails
+    mixed.append(entry("BENCH_decode_o4.json", 900.0, True))
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "BENCH_decode_o3" in fails[0]
 
 
 def test_flush_writes_default_registry(tmp_path):

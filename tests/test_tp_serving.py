@@ -431,3 +431,28 @@ def test_tpu503_spmd_checks_catch_mismatch_and_inert_sharding():
     assert findings, "single-partition lowering of a declared-sharded " \
                      "program produced no TPU503 finding"
     assert any("num_partitions" in f.message for f in findings)
+
+def test_tp2_overlapped_loop_parity_and_compile_once(monkeypatch):
+    """ISSUE 13 x ISSUE 12: the overlapped loop's device-token threading
+    on a SHARDED engine — the threaded (committed, mesh-replicated)
+    outputs and the committed host-token first dispatch must hit the
+    same sharded program (strict watchdog), and greedy output must
+    match the sync loop bit-for-bit."""
+    from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                              Request)
+    monkeypatch.setenv("PADDLE_TPU_STRICT_COMPILE", "1")
+    model = _tiny_model()
+    cfg = model.config
+
+    def drive(overlap):
+        eng = _engine(model, tp=2, page_size=8)
+        sched = ContinuousBatchingScheduler(eng, overlap=overlap)
+        rng = np.random.default_rng(1)
+        rids = [sched.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (8,)),
+            max_new_tokens=6, temperature=0.0)) for _ in range(4)]
+        res = sched.run()
+        assert eng.decode_compile_count == 1
+        return [tuple(int(t) for t in res[r].tokens) for r in rids]
+
+    assert drive(False) == drive(True)

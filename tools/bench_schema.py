@@ -8,9 +8,10 @@ Two input shapes:
 * **Raw lines** (``--line -`` reads stdin, or ``--line '<json>'``): the
   JSON line a bench prints — what the CI bench-smoke pipes in.
 
-The line schema is the contract bench.py / bench_decode.py print:
-required ``metric``/``value``/``unit``; optional ``compile_counts`` (a
-{entry: count>=1} int map), the ISSUE-6 ``metrics`` block::
+The line schema is the contract bench.py / bench_decode.py /
+bench_serve.py print: required ``metric``/``value``/``unit``; optional
+``compile_counts`` (a {entry: count>=1} int map), the ISSUE-6
+``metrics`` block::
 
     "metrics": {
       "histograms": {"<name>": {"p50_ms", "p95_ms", "p99_ms", "count"}},
@@ -37,11 +38,18 @@ count for ENTRY to be exactly 1 — the CI smoke gate that replaced
 bench_decode's ad-hoc assert (the watchdog also enforces it at runtime
 under PADDLE_TPU_STRICT_COMPILE=1; this checks the *reported* line).
 
+**Serve lines (ISSUE 13).**  ``bench_serve.py``'s
+``serve_goodput_tokens_per_sec`` lines additionally carry the load-
+harness fields — ``qps``, ``mix``, client-observed ``ttft_p50_ms``/
+``ttft_p99_ms``/``tpot_p50_ms``/``tpot_p99_ms``, and ``shed_rate`` —
+validated whenever the metric matches (a serve line missing its p99 is
+rejected, not skipped).
+
 **Trajectory mode (ISSUE 7 / ROADMAP item 5 payoff).**  ``--trajectory``
-promotes the loose ``BENCH_r*`` / ``BENCH_decode_*`` wrapper files into
-one schema'd, *gated* series: every wrapper is validated, grouped by
-metric into ordered series (round order = sorted filename), and two
-gates run over each series —
+promotes the loose ``BENCH_r*`` / ``BENCH_decode_*`` / ``BENCH_serve_*``
+wrapper files into one schema'd, *gated* series: every wrapper is
+validated, grouped by metric into ordered series (round order = sorted
+filename), and these gates run over each series —
 
 * **compile counts, every backend**: any entry that reports
   ``compile_counts``/``metrics.compile_counts`` must satisfy the
@@ -50,12 +58,18 @@ gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec, tp)`` cursor key matches (the ISSUE-8 A/B matrix
-  interleaves quantized/speculative lines in one trajectory, and the
-  ISSUE-12 ``--tp`` axis adds tensor-parallel lines — a tp=2 line must
-  never gate against the tp=1 series), a >3% drop in ``value`` fails.
-  CPU entries never perf-gate (smoke numbers), so the gate arms itself
+  kv_dtype, spec, tp, overlap, qps, mix)`` cursor key matches (the
+  ISSUE-8 A/B matrix interleaves quantized/speculative lines in one
+  trajectory, ISSUE 12 adds the ``--tp`` axis, and ISSUE 13 adds the
+  sync-vs-overlapped loop axis plus the serve harness's (QPS, mix)
+  operating points — a tp=2, sync-loop, or qps=16 line must never gate
+  against a different series), a >3% drop in ``value`` fails.  CPU
+  entries never perf-gate (smoke numbers), so the gate arms itself
   automatically the first session that records chip numbers;
+* **serve latency (ISSUE 13)**: over the same like-for-like on-chip
+  pairs of ``serve_goodput_tokens_per_sec`` lines, >3% growth in
+  client-observed p99 TTFT fails — a PR that holds goodput by letting
+  tail latency slide does not pass;
 * **cost cursors (ISSUE 11)**: over the same like-for-like on-chip
   pairs, a >3% ``cost.mfu`` drop or >5% ``cost.peak_bytes`` growth
   fails — a perf PR that holds tokens/s by burning memory (or that
@@ -169,6 +183,30 @@ def validate_trace_block(t: Any, path: str):
                      "int, got %r" % (rid, n))
 
 
+#: fields every serve (load-harness) line must carry beside the generic
+#: metric/value/unit triple — the trajectory's latency gate reads them.
+SERVE_METRIC = "serve_goodput_tokens_per_sec"
+_SERVE_NUM_FIELDS = ("qps", "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                     "tpot_p99_ms", "shed_rate")
+
+
+def validate_serve_fields(doc: Any, path: str):
+    for k in _SERVE_NUM_FIELDS:
+        _require(k in doc, path, "serve line missing %r" % k)
+        _require(_is_num(doc[k]) and doc[k] >= 0, path,
+                 "serve line field %r must be a non-negative number, "
+                 "got %r" % (k, doc[k]))
+    _require(doc["qps"] > 0, path, "serve line 'qps' must be positive")
+    _require(doc["shed_rate"] <= 1.0, path,
+             "serve line 'shed_rate' must be in [0, 1]")
+    _require(doc["ttft_p50_ms"] <= doc["ttft_p99_ms"], path,
+             "serve line TTFT percentiles are not ordered (p50<=p99)")
+    _require(doc["tpot_p50_ms"] <= doc["tpot_p99_ms"], path,
+             "serve line TPOT percentiles are not ordered (p50<=p99)")
+    _require(isinstance(doc.get("mix"), str) and doc.get("mix"), path,
+             "serve line 'mix' must be a non-empty string")
+
+
 def validate_line(doc: Any, path: str,
                   expect_compile_once: List[str] = (),
                   expect_cost: bool = False):
@@ -177,6 +215,8 @@ def validate_line(doc: Any, path: str,
         _require(isinstance(doc.get(k), t), path,
                  "%r must be a %s, got %r" % (k, t.__name__, doc.get(k)))
     _require(_is_num(doc.get("value")), path, "'value' must be a number")
+    if doc.get("metric") == SERVE_METRIC:
+        validate_serve_fields(doc, path)
     if "vs_baseline" in doc:
         _require(_is_num(doc["vs_baseline"]), path,
                  "'vs_baseline' must be a number")
@@ -271,11 +311,14 @@ _COMPILE_ONCE = {
                               ("metrics", "serving.spec_verify"),
                               ("top", "decode"),
                               ("top", "verify")),
+    SERVE_METRIC: (("metrics", "serving.decode"),
+                   ("metrics", "serving.spec_verify")),
 }
 
 REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
 MFU_TOLERANCE = 0.03            # >3% on-chip cost.mfu drop fails
-PEAK_HBM_TOLERANCE = 0.05       # >5% on-chip cost.peak_bytes growth fails
+PEAK_HBM_TOLERANCE = 0.05      # >5% on-chip cost.peak_bytes growth fails
+TTFT_P99_TOLERANCE = 0.03      # >3% on-chip serve p99-TTFT growth fails
 
 
 def check_trajectory(paths: List[str], write: str = None) -> List[str]:
@@ -301,12 +344,15 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "backend": cfg.get("backend"),
             "model": cfg.get("model"),
             "cache_layout": line.get("cache_layout"),
-            # ISSUE-8/12 A/B axes: absent on pre-quant/spec/tp lines —
-            # None then keys its own legacy cursor, so old series stay
-            # gated
+            # ISSUE-8/12/13 A/B axes: absent on older lines — None then
+            # keys its own legacy cursor, so old series stay gated
             "kv_dtype": line.get("kv_dtype"),
             "spec": line.get("spec"),
             "tp": line.get("tp"),
+            "overlap": line.get("overlap"),
+            "qps": line.get("qps"),
+            "mix": line.get("mix"),
+            "ttft_p99_ms": line.get("ttft_p99_ms"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
                 "compile_counts", line.get("compile_counts")),
             "cost": (line.get("cost")
@@ -350,7 +396,8 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             if e["backend"] != "tpu":
                 continue
             key = (e.get("model"), e.get("cache_layout"),
-                   e.get("kv_dtype"), e.get("spec"), e.get("tp"))
+                   e.get("kv_dtype"), e.get("spec"), e.get("tp"),
+                   e.get("overlap"), e.get("qps"), e.get("mix"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
@@ -362,6 +409,22 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                         % (e["file"], metric, 100 * drop, prev["file"],
                            prev["value"], e["value"],
                            100 * REGRESSION_TOLERANCE))
+            # gate 2b — serve tail latency (ISSUE 13): like-for-like
+            # on-chip serve pairs also gate the CLIENT-observed p99
+            # TTFT — goodput held by letting the tail slide fails
+            if (metric == SERVE_METRIC and prev is not None
+                    and _is_num(e.get("ttft_p99_ms"))
+                    and _is_num(prev.get("ttft_p99_ms"))
+                    and prev["ttft_p99_ms"] > 0):
+                growth = e["ttft_p99_ms"] / prev["ttft_p99_ms"] - 1.0
+                if growth > TTFT_P99_TOLERANCE:
+                    failures.append(
+                        "%s: on-chip serve regression — p99 TTFT grew "
+                        "%.1f%% vs %s (%.3f -> %.3f ms; tolerance "
+                        "%.0f%%)" % (e["file"], 100 * growth,
+                                     prev["file"], prev["ttft_p99_ms"],
+                                     e["ttft_p99_ms"],
+                                     100 * TTFT_P99_TOLERANCE))
             # gate 3 — cost cursors (ISSUE 11): like-for-like on-chip
             # pairs also gate MFU (>3% drop) and peak HBM (>5% growth),
             # each against ITS OWN last-carrying anchor.
@@ -437,7 +500,8 @@ def main(argv=None) -> int:
 
     if args.trajectory:
         paths = args.paths or sorted(
-            glob.glob("BENCH_r*.json") + glob.glob("BENCH_decode_*.json"))
+            glob.glob("BENCH_r*.json") + glob.glob("BENCH_decode_*.json")
+            + glob.glob("BENCH_serve_*.json"))
         failures = check_trajectory(paths, write=args.write)
         for f in failures:
             print("TRAJECTORY ERROR — %s" % f, file=sys.stderr)
