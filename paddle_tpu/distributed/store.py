@@ -14,11 +14,22 @@ import time
 from typing import Optional
 
 from ..core import native as _native
+from ..observability import liveness as _liveness
 from ..robustness import retry as _retry
 from ..robustness.faultpoints import declare as _declare, faultpoint
 
 _declare("store.client_op",
          "raise before a TCPStore client op (socket reset, transient IO)")
+
+# liveness beacon over one client op INCLUDING its whole retry schedule
+# (wait()/barrier() poll with fast non-blocking probes, so a healthy
+# rendezvous pulses steadily; a server-side wedge stalls it).  600s
+# default sits above the store's own 300s wait deadline: the store's
+# typed TimeoutError is the first line of defense, the watchdog catches
+# the ops with no deadline of their own (a blocking native get).
+_liveness.declare_beacon(
+    "store.op", "one TCPStore client op (set/get/add) through the "
+    "retry policy", deadline=600.0)
 
 
 class StoreReplyLostError(ConnectionError):
@@ -45,6 +56,8 @@ class TCPStore:
         self._py_server = None
         self._native_buf = None
         self._native_buf_lock = threading.Lock()
+        # fetched once; the NOOP_BEACON singleton when liveness is off
+        self._beacon = _liveness.beacon("store.op")
         lib = _native.load()
         self._lib = lib
         if is_master:
@@ -92,9 +105,10 @@ class TCPStore:
                 except OSError:
                     pass  # next attempt surfaces the (still-broken) link
 
-        return _retry.retry_call(attempt, retry_on=retryable,
-                                 on_retry=reconnect,
-                                 name="TCPStore.%s" % opname)
+        with self._beacon:   # liveness: a wedged store op is a stall
+            return _retry.retry_call(attempt, retry_on=retryable,
+                                     on_retry=reconnect,
+                                     name="TCPStore.%s" % opname)
 
     def set(self, key: str, value):
         data = value if isinstance(value, bytes) else str(value).encode()

@@ -16,7 +16,15 @@ from ..io import DataLoader, Dataset
 from ..jit import TrainStep, functional_call
 from ..metric import Metric
 from ..observability import hbm as _hbm
+from ..observability import liveness as _liveness
 from ..observability import registry as _metrics
+
+# liveness beacon over one fit batch (train_batch INCLUDES the loss
+# fetch — a real device sync, so a wedged device step stalls here even
+# when dispatch itself returned)
+_liveness.declare_beacon(
+    "train.fit_batch", "one hapi fit batch: compiled step dispatch + "
+    "the loss fetch device sync", deadline=600.0)
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRScheduler", "summary", "flops"]
@@ -235,6 +243,7 @@ class Model:
         m_loss = _metrics.gauge("train.loss")
         m_samples = _metrics.counter("train.samples")
         m_tokens = _metrics.counter("train.tokens")
+        b_batch = _liveness.beacon("train.fit_batch")
         it_count = 0
         for epoch in range(epochs):
             for cb in cbs:
@@ -243,7 +252,8 @@ class Model:
             for step, batch in enumerate(train_loader):
                 ins, lbls = self._split_batch(batch)
                 t0 = time.perf_counter()
-                losses, _ = self.train_batch(ins, lbls)
+                with b_batch:
+                    losses, _ = self.train_batch(ins, lbls)
                 m_batch.observe(time.perf_counter() - t0)
                 m_loss.set(losses[0])
                 shape = getattr(ins[0], "shape", None)

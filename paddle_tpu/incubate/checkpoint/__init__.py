@@ -41,8 +41,16 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability import liveness as _liveness
 from ...robustness import retry as _retry
 from ...robustness.faultpoints import declare as _declare, faultpoint
+
+# liveness beacon over one full checkpoint write (shard + manifest +
+# barrier + publish), worker-thread and inline paths alike: the classic
+# hang this watchdog exists for is an NFS write that never returns
+_liveness.declare_beacon(
+    "checkpoint.writer", "one checkpoint save drained by the writer "
+    "(shard write + manifest + publish barriers)", deadline=600.0)
 
 __all__ = ["CheckpointManager", "ResumableIterator", "TrainEpochRange",
            "CheckpointWriteError", "CheckpointCorruptionError",
@@ -249,6 +257,8 @@ class CheckpointManager:
         if async_save:
             self._worker = threading.Thread(target=self._drain, daemon=True)
             self._worker.start()
+        # fetched once; the NOOP_BEACON singleton when liveness is off
+        self._beacon = _liveness.beacon("checkpoint.writer")
         _live_managers.add(self)
 
     # -- save ---------------------------------------------------------------
@@ -298,6 +308,10 @@ class CheckpointManager:
         return f"host-{host}.manifest.json"
 
     def _write(self, step: int, payload):
+        with self._beacon:
+            return self._write_guarded(step, payload)
+
+    def _write_guarded(self, step: int, payload):
         from ...observability import registry as _metrics
         t0 = time.perf_counter()
         final = os.path.join(self.directory, f"ckpt-{step}")

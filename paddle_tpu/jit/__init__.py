@@ -26,11 +26,19 @@ from ..core import random as _rnd
 from ..core.grad_mode import no_grad
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+from ..observability import liveness as _liveness
 from ..robustness.faultpoints import declare as _declare, faultpoint
 
 _declare("train.grads",
          "mutate the host-side batch before the compiled step (NaNBatch "
          "here yields NaN loss + NaN grads at a chosen step)")
+
+# liveness beacon over one compiled TrainStep call (dispatch + the
+# opt-in grad-norm sync); 600s default covers the first call's XLA
+# compile — a wedged collective inside the step stalls it
+_liveness.declare_beacon(
+    "train.step", "one compiled TrainStep call (forward + backward + "
+    "optimizer dispatch)", deadline=600.0)
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "save", "load", "TranslatedLayer"]
@@ -627,6 +635,8 @@ class TrainStep:
         self._m_step_seconds = _obs.histogram("train.step_seconds")
         self._m_steps = _obs.counter("train.steps")
         self._m_grad_norm = _obs.gauge("train.grad_norm")
+        # fetched once; the NOOP_BEACON singleton when liveness is off
+        self._beacon = _liveness.beacon("train.step")
 
     def trace_args(self, batch):
         """The exact argument tuple ``self._step`` runs with, for
@@ -683,13 +693,16 @@ class TrainStep:
                 for b, s in zip(batch_a, specs))
         import time as _time
         t0 = _time.perf_counter()
-        out = self._step(
-            self.params, self.buffers, self.opt_state, lr, rng, batch_a)
-        if self._emit_grad_norm:
-            loss, self.params, self.buffers, self.opt_state, gnorm = out
-            self._m_grad_norm.set(float(gnorm))   # opt-in: syncs the step
-        else:
-            loss, self.params, self.buffers, self.opt_state = out
+        with self._beacon:   # liveness: a hang inside the step is a stall
+            out = self._step(
+                self.params, self.buffers, self.opt_state, lr, rng,
+                batch_a)
+            if self._emit_grad_norm:
+                loss, self.params, self.buffers, self.opt_state, gnorm \
+                    = out
+                self._m_grad_norm.set(float(gnorm))  # opt-in: syncs step
+            else:
+                loss, self.params, self.buffers, self.opt_state = out
         self._m_step_seconds.observe(_time.perf_counter() - t0)
         self._m_steps.inc()
         self._dirty = True

@@ -38,6 +38,16 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..observability import liveness as _liveness
+
+# liveness beacon over one timed candidate-selection run: candidates
+# compile + run on device in a loop, and a hung device wedges the warm
+# silently.  900s default: a full family sweep pays one compile per
+# candidate.
+_liveness.declare_beacon(
+    "autotune.tune", "one timed autotune selection (compile + time "
+    "every candidate for one key)", deadline=900.0)
+
 __all__ = [
     "register_family", "resolve", "tune", "warm", "clear_cache",
     "cache_path", "enabled", "key_str", "families",
@@ -340,7 +350,9 @@ def tune(family_name: str, key: dict, persist: bool = True,
     from ..observability import registry as _obs
     _tune_t0 = time.perf_counter()
     try:
-        with _record_event("autotune::%s::%s" % (family_name, ks)):
+        # tune() is cold-path: fetching the beacon per call is fine
+        with _liveness.beacon("autotune.tune"), \
+                _record_event("autotune::%s::%s" % (family_name, ks)):
             for cand in cands:
                 sig = _cand_sig(cand)
                 rejected = _vmem_reject(fam, cand, key)

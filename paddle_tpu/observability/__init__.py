@@ -36,10 +36,24 @@ subsystem:
   live bytes / engine KV-pool bytes / checkpoint-restore transients,
   sampled at step boundaries when armed (``PADDLE_TPU_HBM=1``), with
   chrome-trace counter lanes and flight-dump snapshots.
+* :mod:`.liveness` — the liveness watchdog (ISSUE 14): named progress
+  beacons at every hot boundary (train step, fit batch, scheduler
+  step, frontend threads, checkpoint writer, store ops, autotune),
+  watched by a monitor thread with per-beacon deadlines; a stall dumps
+  all-thread stacks into a ``"stall"`` flight dump, increments
+  ``liveness.stalls{beacon=}``, and can hard-exit with a configurable
+  rc so the elastic launcher respawns the wedged worker
+  (``PADDLE_TPU_LIVENESS=1`` arms it — no-op beacon singleton
+  otherwise).
+* :mod:`.aggregate` — cross-host telemetry (ISSUE 14): per-host
+  snapshot publication through the retry-wrapped distributed store and
+  the host-0 cluster merge with step-time straggler detection
+  (``liveness.straggler{host=}``).
 * CLI: ``python -m paddle_tpu.observability
-  dump|serve|tail|trace-report|programs`` over the JSONL snapshot
-  stream (``PADDLE_TPU_METRICS_FILE``), span trace files, and the
-  canonical program registry.
+  dump|serve|tail|trace-report|programs|cluster`` over the JSONL
+  snapshot stream (``PADDLE_TPU_METRICS_FILE``), span trace files, the
+  canonical program registry, and the distributed-store telemetry
+  keys.
 
 Import discipline: this package must stay importable before (and without)
 jax — the registry is pure stdlib; jax-adjacent pieces (profiler marks)
@@ -47,7 +61,7 @@ import lazily.  See OBSERVABILITY.md for the metric catalog and knobs.
 """
 from __future__ import annotations
 
-from . import costs, flight, hbm
+from . import aggregate, costs, flight, hbm, liveness
 from .catalog import CATALOG
 from .registry import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
                        Gauge, Histogram, Registry, counter, default_registry,
@@ -63,5 +77,5 @@ __all__ = [
     "RecompileError", "RecompileWarning", "WatchedEntry", "watch",
     "compile_counts",
     "Tracer", "NOOP_TRACER", "NOOP_SPAN", "default_tracer", "flight",
-    "costs", "hbm",
+    "costs", "hbm", "liveness", "aggregate",
 ]

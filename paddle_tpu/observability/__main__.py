@@ -7,6 +7,8 @@
         [--format table|json] [--chrome OUT] [--allow-empty] [--sli]
     python -m paddle_tpu.observability programs [patterns] \\
         [--format table|json]
+    python -m paddle_tpu.observability cluster [--master host:port] \\
+        [--world N] [--pct P] [--format table|json]
 
 ``trace-report`` (ISSUE 9) reconstructs per-request timelines from a
 span trace (the JSONL a :class:`~.tracing.Tracer` exports — see
@@ -26,6 +28,15 @@ row per program (:mod:`.costs`).  Same operational discipline as the
 green), broken builders exit 1, and the process must be launched with
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` off-chip so the
 pipeline program gets its mesh (CI does).
+
+``cluster`` (ISSUE 14) renders the merged cross-host view: it connects
+a client to the distributed store every host publishes its telemetry
+snapshot through (:mod:`.aggregate`), fetches all ``world`` hosts'
+newest snapshots, and prints the per-host step-time table with
+straggler flags (> ``--pct`` percent over the cluster median) and
+stalled-beacon columns.  Exit 2 when NO host has published (never
+silent green), exit 1 when some hosts are missing — a wedged worker
+that stopped publishing is the loudest row in the table.
 
 ``--file`` defaults to ``$PADDLE_TPU_METRICS_FILE``.  ``dump`` renders the
 newest snapshot (Prometheus text by default); with no file configured it
@@ -231,6 +242,50 @@ def cmd_programs(args) -> int:
     return 1 if errors else 0
 
 
+def cmd_cluster(args) -> int:
+    """The merged cross-host telemetry table (``--trace`` CLI
+    discipline: an empty cluster exits 2, partial publication exits 1,
+    both loud)."""
+    from . import aggregate
+    if not args.master:
+        print("cluster needs --master host:port (or PADDLE_MASTER)",
+              file=sys.stderr)
+        return 2
+    host, _, port = args.master.rpartition(":")
+    if not host or not port.isdigit():
+        print("cluster: malformed --master %r (want host:port)"
+              % args.master, file=sys.stderr)
+        return 2
+    from ..distributed.store import TCPStore
+    try:
+        store = TCPStore(host, int(port), is_master=False,
+                         world_size=args.world, timeout=args.timeout)
+        docs, missing = aggregate.fetch_cluster(store, args.world)
+    except (ConnectionError, OSError, RuntimeError) as e:
+        # a dead/unreachable master is the exit-2 case (nothing could
+        # be fetched), NOT exit 1 ("some hosts missing") — an operator
+        # script keying on the rc must be able to tell them apart
+        print("cluster: cannot reach the store at %s: %s"
+              % (args.master, e), file=sys.stderr)
+        return 2
+    if not docs:
+        print("cluster: NO host has published telemetry (of %d) — "
+              "publishers not started, wrong --master, or the whole "
+              "fleet is wedged" % args.world, file=sys.stderr)
+        return 2
+    doc = aggregate.merge_docs(docs, args.world, pct=args.pct,
+                               set_gauges=False)
+    if args.format == "json":
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(aggregate.format_cluster(doc))
+    if missing:
+        print("cluster: %d host(s) missing: %s" % (len(missing), missing),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_serve(args) -> int:
     srv = make_server(args.file, args.port)
     print("serving /metrics on http://127.0.0.1:%d (source: %s)"
@@ -292,6 +347,30 @@ def main(argv=None) -> int:
     g.add_argument("--format", choices=("table", "json"),
                    default="table")
     g.set_defaults(fn=cmd_programs)
+
+    c = sub.add_parser("cluster",
+                       help="merged cross-host telemetry view from the "
+                            "distributed store (per-host step times, "
+                            "straggler flags, stalled beacons, missing "
+                            "hosts)")
+    c.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="the distributed store endpoint host:port "
+                        "(default: $PADDLE_MASTER)")
+    c.add_argument("--world", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                              "1")),
+                   help="hosts expected to publish (default: "
+                        "$PADDLE_TRAINERS_NUM)")
+    c.add_argument("--timeout", type=float, default=10.0,
+                   help="seconds to keep dialing an unreachable store "
+                        "before exiting 2")
+    c.add_argument("--pct", type=float, default=None,
+                   help="straggler threshold: flag hosts whose step p50 "
+                        "exceeds the median by more than this percent "
+                        "(default 25, or $PADDLE_TPU_STRAGGLER_PCT)")
+    c.add_argument("--format", choices=("table", "json"),
+                   default="table")
+    c.set_defaults(fn=cmd_cluster)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -171,6 +171,20 @@ CATALOG = {
         "watchdog warns/raises when a compile-once entry exceeds its "
         "budget)", labels=("entry",)),
 
+    # -- liveness watchdog + cluster view (observability.liveness /
+    # .aggregate — armed via PADDLE_TPU_LIVENESS=1) -------------------------
+    "liveness.stalls": _m(
+        "counter", "stalls the liveness monitor fired: a declared "
+        "progress beacon with work inflight made no progress past its "
+        "deadline (each fire also produced an all-thread-stack flight "
+        "dump; label space bounded by the declared beacon registry)",
+        labels=("beacon",)),
+    "liveness.straggler": _m(
+        "gauge", "per-host straggler flag from the host-0 cluster merge "
+        "(1 = this host's step-time p50 exceeds the cluster median by "
+        "more than PADDLE_TPU_STRAGGLER_PCT percent, 0 = on pace; label "
+        "space bounded by world size)", labels=("host",)),
+
     # -- HBM ledger (observability.hbm — armed via PADDLE_TPU_HBM=1) --------
     "hbm.live_bytes": _m(
         "gauge", "live device bytes per device (summed jax.live_arrays(), "

@@ -36,6 +36,23 @@ snapshot (:func:`~paddle_tpu.observability.hbm.ledger_state` — fresh
 per-device live bytes, top-arrays breakdown, KV-pool pricing: the "what
 held the memory" answer an OOM post-mortem needs).
 
+Two further trigger classes (ISSUE 14 satellites):
+
+* **Uncaught worker-thread exceptions** — a background thread (the
+  checkpoint writer, a frontend thread, any user thread) dying outside
+  the typed-trigger set used to leave no black-box record.
+  :func:`threading.excepthook` is chained at import: the dying thread's
+  name, exception, and all-thread stacks land in a
+  ``"thread_exception"`` dump before the previous hook (CPython's
+  stderr print) runs.  One ``None`` check when the recorder is off.
+* **Manual postmortem on signal** — ``PADDLE_TPU_FLIGHT_SIGNAL=SIGQUIT``
+  (any signal name/number list) installs a handler that dumps
+  all-thread stacks to stderr *from the handler frame* (faulthandler's
+  C implementation: safe even when every Python lock is held) and then
+  fires the ring dump from a fresh thread (kind ``"signal"``) — the
+  operator's "what is this live-but-silent process doing" probe,
+  without killing it.
+
 Disabled by default (``PADDLE_TPU_FLIGHT=0`` — registry discipline):
 ``record()`` is one module-global ``None`` check and dump triggers
 no-op, so chaos tests and production opt in via the env var or
@@ -44,8 +61,10 @@ mask the fault that triggered it.
 """
 from __future__ import annotations
 
+import faulthandler
 import json
 import os
+import signal as _signal
 import sys
 import threading
 import time
@@ -58,7 +77,8 @@ from . import registry as _registry
 __all__ = [
     "FlightRecorder", "enable", "disable", "active", "record",
     "register_engine", "note_registry_reset", "crash_dump",
-    "last_dump_path", "RING_DEFAULT",
+    "last_dump_path", "RING_DEFAULT", "install_signal_handler",
+    "thread_exception_dump",
 ]
 
 #: default ring capacity (events); override with PADDLE_TPU_FLIGHT_RING
@@ -240,6 +260,146 @@ def last_dump_path() -> Optional[str]:
     if r is None or not r.dumps:
         return None
     return r.dumps[-1]
+
+
+# ---------------------------------------------------------------------------
+# uncaught worker-thread exceptions (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+def _all_thread_stacks() -> str:
+    from .liveness import all_thread_stacks
+    return all_thread_stacks()
+
+
+_PREV_THREAD_EXCEPTHOOK = None
+
+
+def thread_exception_dump(thread_name: str, exc: BaseException,
+                          tb=None) -> Optional[str]:
+    """One ``"thread_exception"`` flight dump for a dying worker thread
+    (the excepthook below and any component that catches its own
+    thread's death — the serving frontend — share this, so the dump
+    shape cannot drift).  One ``None`` check when the recorder is
+    disarmed: the stack collection is never paid for nothing.  Never
+    raises."""
+    if _ACTIVE is None:
+        return None
+    try:
+        import traceback as _tb
+        tb_text = "".join(_tb.format_exception(
+            type(exc), exc, exc.__traceback__ if tb is None else tb))
+        record("thread_exception", thread=thread_name, error=repr(exc))
+        # "traceback" is the dying thread's unwound frames; "stacks" is
+        # every OTHER thread at death time (a hook runs on the dying
+        # thread, whose live frames are the hook's own)
+        return crash_dump({"kind": "thread_exception",
+                           "thread": thread_name, "error": repr(exc),
+                           "traceback": tb_text,
+                           "stacks": _all_thread_stacks()})
+    except Exception:
+        return None   # never mask the thread's own traceback print
+
+
+def _thread_excepthook(args):
+    """Chained :func:`threading.excepthook`: a worker thread dying on an
+    uncaught exception gets a black-box record BEFORE the interpreter's
+    default stderr print — today that death is otherwise invisible to
+    every postmortem (the typed triggers only cover faults the hardened
+    code anticipated).  SystemExit is a normal thread exit, not a
+    fault."""
+    if args.exc_type is not SystemExit and args.exc_value is not None:
+        name = args.thread.name if args.thread is not None else "?"
+        thread_exception_dump(name, args.exc_value,
+                              tb=args.exc_traceback)
+    _PREV_THREAD_EXCEPTHOOK(args)
+
+
+def _install_thread_excepthook():
+    global _PREV_THREAD_EXCEPTHOOK
+    if _PREV_THREAD_EXCEPTHOOK is None:
+        _PREV_THREAD_EXCEPTHOOK = threading.excepthook
+        threading.excepthook = _thread_excepthook
+
+
+_install_thread_excepthook()
+
+
+# ---------------------------------------------------------------------------
+# manual postmortem trigger (ISSUE 14 satellite): PADDLE_TPU_FLIGHT_SIGNAL
+# ---------------------------------------------------------------------------
+
+def _on_flight_signal(signum, frame):
+    # the Python half of the postmortem: the all-thread stderr stacks
+    # already fired from faulthandler's C-LEVEL handler (registered
+    # with chain=True below — it runs even while the main thread is
+    # wedged inside native code, the motivating hang; THIS handler only
+    # runs at the next bytecode boundary).  Here we add the ring dump,
+    # on a FRESH thread: it needs Python locks and file IO, and if the
+    # process is wedged on a lock the C stacks still landed, which is
+    # the postmortem that matters.
+    name = _signal.Signals(signum).name
+    try:
+        sys.stderr.write("[flight] %s received — all-thread stacks "
+                         "dumped; writing the flight ring\n" % name)
+        sys.stderr.flush()
+    except Exception:
+        pass
+
+    def _dump():
+        stacks = _all_thread_stacks()
+        record("signal", signal=name)
+        crash_dump({"kind": "signal", "signal": name, "stacks": stacks})
+
+    threading.Thread(target=_dump, name="flight-signal-dump",
+                     daemon=True).start()
+
+
+def install_signal_handler(spec: Optional[str] = None) -> List[str]:
+    """Install the manual-postmortem handler for every signal named in
+    ``spec`` (or ``$PADDLE_TPU_FLIGHT_SIGNAL``): comma-separated names
+    or numbers, e.g. ``SIGQUIT``.  Two layers per signal: a
+    ``faulthandler.register(..., chain=True)`` C-level handler (the
+    all-thread stack dump — fires even when the main thread is blocked
+    inside a native call, where a Python-level handler can never run)
+    chained onto a Python handler that adds the flight ring dump when
+    the interpreter next reaches a bytecode boundary.  Returns the
+    names installed; no-op (empty list) when unset or not on the main
+    thread."""
+    spec = spec if spec is not None else os.environ.get(
+        "PADDLE_TPU_FLIGHT_SIGNAL", "")
+    installed = []
+    for tok in (t.strip() for t in spec.split(",")):
+        if not tok:
+            continue
+        if tok.isdigit():
+            sig = _signal.Signals(int(tok))
+        elif hasattr(_signal, tok):
+            sig = getattr(_signal, tok)
+        else:
+            raise ValueError(
+                "PADDLE_TPU_FLIGHT_SIGNAL: unknown signal %r" % tok)
+        try:
+            # Python handler FIRST, then the C handler chains to it:
+            # stacks dump immediately in C, the ring dump follows when
+            # (if) the main thread returns to Python
+            _signal.signal(sig, _on_flight_signal)
+            faulthandler.register(sig, all_threads=True, chain=True)
+        except (ValueError, OSError, RuntimeError, AttributeError):
+            # not the main thread, an uncatchable signal (SIGKILL), or
+            # a platform without register(): skip, never crash
+            continue
+        installed.append(sig.name)
+    return installed
+
+
+# import-time install degrades LOUDLY, never fatally: a typo'd value in
+# an optional postmortem knob must not make `import paddle_tpu` itself
+# crash every job that never wanted the handler
+try:
+    install_signal_handler()
+except (ValueError, OSError) as _e:
+    sys.stderr.write("[flight] PADDLE_TPU_FLIGHT_SIGNAL ignored: %s\n"
+                     % (_e,))
 
 
 # env opt-in: PADDLE_TPU_FLIGHT=1 arms the recorder at import time (the

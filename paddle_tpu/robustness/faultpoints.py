@@ -37,7 +37,7 @@ __all__ = [
     "FaultPlan", "FaultAction", "chaos", "faultpoint", "declare",
     "active_plan", "SITES",
     "Raise", "DiskFull", "TornFile", "BitFlip", "SocketReset", "NaNBatch",
-    "ForceFoundInf", "Preempt", "HardExit",
+    "ForceFoundInf", "Preempt", "HardExit", "Hang",
 ]
 
 #: name -> one-line description of what failure the site simulates.
@@ -211,6 +211,26 @@ class HardExit(FaultAction):
 
     def fire(self, ctx, plan):
         os._exit(self.rc)
+
+
+class Hang(FaultAction):
+    """Sleep ``seconds`` at the site, then let the operation proceed —
+    the injected *stall* (a wedged NFS write, a stuck collective, a
+    deadlocked peer) rather than an injected crash.  Nothing raises and
+    nothing is corrupted: the only signal is the missing progress,
+    which is exactly what the liveness watchdog
+    (:mod:`paddle_tpu.observability.liveness`) exists to detect.
+    Composes with every plan schedule like any other action."""
+
+    def __init__(self, seconds: float = 1.0):
+        self.seconds = float(seconds)
+
+    def fire(self, ctx, plan):
+        import time
+        time.sleep(self.seconds)
+
+    def __repr__(self):
+        return "Hang(%gs)" % self.seconds
 
 
 # --------------------------------------------------------------------------

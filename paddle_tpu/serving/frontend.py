@@ -11,7 +11,12 @@ engine into a network service:
   ``data: {"tokens": [...]}`` event per appended run as the scheduler
   commits it, then a final ``data: {"done": true, ...}`` event.
   ``stream: false`` buffers and answers one JSON document.
-* ``GET /healthz`` — liveness + drain state.
+* ``GET /healthz`` — liveness + drain state, enriched (ISSUE 14) with
+  the watchdog's beacon ages (``stalled`` names any beacon past its
+  deadline and flips ``status`` to ``"stalled"``), admission queue
+  depth, active slots, and open-stream counts — served from the LOOP
+  thread, so an external probe detects a scheduler thread that is
+  wedged while the socket still accepts.
 
 **Thread model.**  Three kinds of thread touch this object: the asyncio
 *loop thread* (owns the server sockets and every stream), the
@@ -65,12 +70,30 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observability import liveness as _liveness
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
 from ..robustness.faultpoints import declare, faultpoint
 from .scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = ["ServingFrontend"]
+
+# liveness beacons over the two frontend worker threads (ISSUE 14): a
+# deadlocked scheduler thread or a wedged event loop keeps sockets
+# accept-able while no request progresses — exactly the hang /healthz
+# must surface.  The sched-thread beacon guards every loop iteration
+# (idle waits time out at 20ms, so a healthy thread pulses constantly);
+# the loop thread is covered by a heartbeat task pulsing from inside
+# the event loop, so a blocked loop (a callback that never returns)
+# stops stamping.
+_liveness.declare_beacon(
+    "serve.frontend_sched",
+    "one frontend scheduler-thread loop iteration (submit/cancel "
+    "drain + scheduler step)", deadline=600.0)
+_liveness.declare_beacon(
+    "serve.frontend_loop",
+    "asyncio event-loop heartbeat (pulses from a task inside the "
+    "loop; a blocked loop stops stamping)", deadline=60.0)
 
 #: chaos site: fired immediately before every SSE event write, so a
 #: scheduled SocketReset simulates a mid-stream client disconnect at an
@@ -198,8 +221,11 @@ class ServingFrontend:
 
     def _sched_main(self):
         sched = self.scheduler
+        b = _liveness.beacon("serve.frontend_sched")
+        b.begin()     # watched for the thread's whole lifetime
         try:
             while True:
+                b.pulse()
                 if (self._guard is not None and self._guard.preempted
                         and not self._draining):
                     # the guard flipped (SIGTERM / chaos Preempt): stop
@@ -274,6 +300,13 @@ class ServingFrontend:
                     break
         except BaseException as e:        # surfaced by stop()
             self._sched_error = e
+            # the black-box record (ISSUE 14 satellite): this catch
+            # keeps the death off threading.excepthook, so the same
+            # flight dump every other dying worker thread gets is fired
+            # here explicitly — a scheduler-thread crash must not be
+            # reconstructable only from a client's "error" event
+            from ..observability import flight as _flight
+            _flight.thread_exception_dump("serve-frontend-sched", e)
             self._drained.set()
             # never leave a connected client awaiting a queue that can
             # no longer be fed — flush an error-done to every stream
@@ -284,6 +317,8 @@ class ServingFrontend:
                                       "tpot_ms": 0.0,
                                       "queue_wait_ms": 0.0}))
             self._streams.clear()
+        finally:
+            b.done()      # thread exiting: stop watching this beacon
 
     # scheduler-thread callbacks -------------------------------------------
 
@@ -312,6 +347,22 @@ class ServingFrontend:
 
     # -- loop thread -------------------------------------------------------
 
+    async def _heartbeat(self):
+        """Loop-thread liveness: pulse from INSIDE the event loop, so a
+        loop blocked by a wedged callback stops stamping and the
+        monitor attributes the stall to ``serve.frontend_loop``."""
+        b = _liveness.beacon("serve.frontend_loop")
+        interval = max(min(
+            _liveness.deadline_for("serve.frontend_loop") / 4.0, 1.0),
+            0.01)
+        b.begin()
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                b.pulse()
+        finally:
+            b.done()
+
     def _loop_main(self):
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
@@ -323,9 +374,19 @@ class ServingFrontend:
 
         self._loop.run_until_complete(_boot())
         self._started.set()
+        # liveness heartbeat only when a monitor is armed: a disabled
+        # stack schedules nothing on the loop
+        hb = (self._loop.create_task(self._heartbeat())
+              if _liveness.active() is not None else None)
         try:
             self._loop.run_forever()
         finally:
+            if hb is not None:
+                hb.cancel()
+                try:
+                    self._loop.run_until_complete(hb)
+                except (asyncio.CancelledError, Exception):
+                    pass
             self._server.close()
             self._loop.run_until_complete(self._server.wait_closed())
             self._loop.close()
@@ -339,10 +400,27 @@ class ServingFrontend:
                     *_DISCONNECT_ERRORS):
                 return
             if method == "GET" and path == "/healthz":
+                # liveness-enriched health (ISSUE 14): an external probe
+                # must be able to tell "socket alive but not
+                # progressing" from healthy.  Beacon ages come from
+                # liveness.state() (computed on read — the stall shows
+                # as soon as age crosses the deadline, no monitor poll
+                # needed), and this handler runs on the LOOP thread, so
+                # it still answers while the scheduler thread is wedged
+                # — which is exactly the scenario.
+                beacons = _liveness.state()
+                stalled = sorted(n for n, s in beacons.items()
+                                 if s["stalled"])
                 await self._respond_json(writer, 200, {
-                    "status": "draining" if self._draining else "ok",
+                    "status": ("stalled" if stalled else
+                               "draining" if self._draining else "ok"),
+                    "stalled": stalled,
+                    "beacons": beacons,
                     "open_streams": self._open_streams,
                     "outstanding": self._outstanding,
+                    "queue_depth": len(self.scheduler.waiting),
+                    "slots_active": sum(
+                        a is not None for a in self.scheduler.slots),
                 })
                 return
             if method != "POST" or path != "/v1/generate":

@@ -105,12 +105,30 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observability import hbm as _hbm
+from ..observability import liveness as _liveness
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
+from ..robustness.faultpoints import declare as _declare, faultpoint
 from .engine import PagePoolExhausted
 from .spec import propose as _propose_draft
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
+
+#: chaos site on the scheduler's hot iteration, INSIDE the liveness
+#: beacon's guard: a scheduled ``Hang`` here simulates a wedged decode
+#: loop (stuck collective / device hang) and must trip the watchdog
+STEP_SITE = _declare(
+    "serve.step",
+    "fires at the top of every scheduler iteration (a Hang here "
+    "simulates a wedged decode loop for the liveness watchdog)")
+
+#: liveness beacon over one scheduler iteration; generous default —
+#: the first iteration pays the decode/prefill XLA compiles
+_declare_beacon = _liveness.declare_beacon
+_declare_beacon("serve.scheduler_step",
+                "one continuous-batching scheduler iteration (admit + "
+                "prefill chunk + batched decode dispatch/consume)",
+                deadline=600.0)
 
 
 @dataclasses.dataclass
@@ -303,6 +321,10 @@ class ContinuousBatchingScheduler:
             "serving.finished_requests", ("reason",))
         self._m_occupancy = _metrics.gauge("serving.slot_occupancy")
         self._m_queue_depth = _metrics.gauge("serving.queue_depth")
+        # liveness beacon, fetched ONCE: disabled (the default) it is
+        # the module NOOP_BEACON by identity — the per-iteration guard
+        # is then two empty method calls (tests assert the identity)
+        self._beacon = _liveness.beacon("serve.scheduler_step")
 
     # -- intake ------------------------------------------------------------
 
@@ -825,7 +847,18 @@ class ContinuousBatchingScheduler:
         Overlapped (the default): dispatch step t BEFORE consuming step
         t-1, so the host bookkeeping below overlaps the device's compute
         for step t.  Returns decode tokens produced this iteration
-        (prefill first-tokens excluded)."""
+        (prefill first-tokens excluded).
+
+        The whole iteration runs inside the ``serve.scheduler_step``
+        liveness beacon's guard: an iteration that wedges (hung
+        collective, injected ``Hang`` at the ``serve.step`` site) is a
+        stall the watchdog can attribute, while an idle scheduler
+        (between ``run()`` drives) is simply unwatched."""
+        with self._beacon:
+            faultpoint(STEP_SITE, scheduler=self)
+            return self._step_inner()
+
+    def _step_inner(self) -> int:
         self._drained_n = 0
         self.admit()
         self.prefill_once()

@@ -1165,6 +1165,30 @@ def test_serve_stream_site_declared():
     assert "serve.stream" in fp.SITES
 
 
+def test_hang_action_sleeps_at_site_and_composes(monkeypatch):
+    """ISSUE 14: the Hang action is an injected *stall*, not a crash —
+    the site completes after the sleep, nothing raises, and it rides
+    the normal plan schedules (the liveness suite proves the watchdog
+    catches it at the beacon-covered sites; serve.step is the
+    scheduler-loop injection point it added)."""
+    import time as _time
+
+    import paddle_tpu.serving.scheduler  # noqa: F401  (declares the site)
+    assert "serve.step" in fp.SITES
+    fp.declare("test.chaos_hang", "suite probe")
+    plan = fp.FaultPlan(seed=0).inject("test.chaos_hang", fp.Hang(0.05),
+                                       every=2, times=1)
+    with fp.chaos(plan):
+        t0 = _time.perf_counter()
+        ctx = fp.faultpoint("test.chaos_hang", payload=1)
+        assert _time.perf_counter() - t0 >= 0.05
+        assert ctx["payload"] == 1          # ctx untouched: pure stall
+        t0 = _time.perf_counter()
+        fp.faultpoint("test.chaos_hang")    # times=1 exhausted
+        assert _time.perf_counter() - t0 < 0.05
+    plan.assert_all_fired()
+
+
 @pytest.mark.slow
 def test_injected_stream_reset_cancels_and_frees_pages():
     """A SocketReset injected at the serve.stream site (= the client

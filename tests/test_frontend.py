@@ -266,3 +266,121 @@ def test_frontend_metrics_goodput_and_open_streams(engine):
         assert fe._open_streams == 0
     finally:
         fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: liveness-enriched /healthz + scheduler-thread black box
+# ---------------------------------------------------------------------------
+
+def _healthz(fe):
+    s = socket.create_connection((fe.host, fe.port), timeout=10)
+    s.sendall(b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n")
+    raw = b""
+    while True:
+        b = s.recv(65536)
+        if not b:
+            break
+        raw += b
+    s.close()
+    return json.loads(raw.partition(b"\r\n\r\n")[2])
+
+
+@pytest.mark.slow
+def test_healthz_degraded_when_scheduler_stalled(engine, monkeypatch):
+    """ISSUE 14 satellite: /healthz must let an external probe tell
+    "socket alive but not progressing" from healthy.  The loop thread
+    answers while the scheduler thread sits in an injected Hang, so the
+    degraded response — status "stalled", the stalled beacon named, its
+    age past the deadline — is observable DURING the stall, and the
+    server recovers to "ok" afterwards."""
+    from paddle_tpu.observability import liveness
+    from paddle_tpu.robustness.faultpoints import FaultPlan, Hang, chaos
+    monkeypatch.setenv(
+        "PADDLE_TPU_LIVENESS_DEADLINE_SERVE_SCHEDULER_STEP", "0.05")
+    liveness.enable(start=False)   # state() is computed on read — the
+    try:                           # probe needs no monitor thread
+        engine.reset()
+        fe = ServingFrontend(engine, queue_limit=8)
+        fe.start()
+        try:
+            base = _healthz(fe)
+            assert base["status"] == "ok"
+            assert base["stalled"] == []
+            for key in ("beacons", "queue_depth", "open_streams",
+                        "slots_active", "outstanding"):
+                assert key in base, key
+            plan = FaultPlan(seed=0).inject("serve.step", Hang(1.2),
+                                            at=0)
+            with chaos(plan):
+                s = _raw_post(fe.host, fe.port,
+                              {"prompt": [3, 1, 4, 1], "max_new_tokens": 3,
+                               "temperature": 0.0}, read_all=False)
+                degraded = None
+                deadline = time.time() + 10.0
+                while degraded is None and time.time() < deadline:
+                    doc = _healthz(fe)
+                    if doc["status"] == "stalled":
+                        degraded = doc
+                    else:
+                        time.sleep(0.02)
+                assert degraded, "healthz never reported the stall"
+                assert "serve.scheduler_step" in degraded["stalled"]
+                b = degraded["beacons"]["serve.scheduler_step"]
+                assert b["stalled"] and b["age_s"] > 0.05
+                # drain the stream: the hang ends, the request finishes
+                buf = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                s.close()
+            plan.assert_all_fired()
+            done = [e for e in _sse_events(buf.partition(b"\r\n\r\n")[2])
+                    if e.get("done")]
+            assert done and done[0]["finish_reason"] == "length"
+            recovered = _healthz(fe)
+            assert recovered["status"] == "ok"
+            assert recovered["stalled"] == []
+        finally:
+            fe.stop()
+    finally:
+        liveness.disable()
+
+
+@pytest.mark.slow
+def test_sched_thread_death_leaves_flight_record(engine, tmp_path):
+    """ISSUE 14 satellite: the scheduler thread dying on an uncaught
+    error is a black-box event — the flight dump names the thread and
+    the error (this catch never reaches threading.excepthook, so the
+    frontend fires the dump itself), every open stream still gets its
+    error-done event, and stop() re-raises."""
+    from paddle_tpu.observability import flight
+    from paddle_tpu.robustness.faultpoints import FaultPlan, Raise, chaos
+    flight.enable(dir=str(tmp_path))
+    try:
+        engine.reset()
+        fe = ServingFrontend(engine, queue_limit=8)
+        fe.start()
+        plan = FaultPlan(seed=0).inject(
+            "serve.step", Raise(RuntimeError("injected sched death")),
+            at=0)
+        with chaos(plan):
+            status, _, rest = _parse(_raw_post(
+                fe.host, fe.port,
+                {"prompt": [1, 2, 3], "max_new_tokens": 3,
+                 "temperature": 0.0}))
+        plan.assert_all_fired()
+        assert status == 200
+        done = [e for e in _sse_events(rest) if e.get("done")]
+        assert done and done[0]["finish_reason"] == "error"
+        path = flight.last_dump_path()
+        assert path, "scheduler-thread death left no flight dump"
+        doc = json.load(open(path))
+        assert doc["trigger"]["kind"] == "thread_exception"
+        assert doc["trigger"]["thread"] == "serve-frontend-sched"
+        assert "injected sched death" in doc["trigger"]["error"]
+        with pytest.raises(RuntimeError, match="injected sched death"):
+            fe.stop()
+    finally:
+        flight.disable()

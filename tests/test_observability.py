@@ -353,6 +353,34 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     finally:
         hbm.disable()
 
+    # -- liveness watchdog + cluster straggler view (ISSUE 14) -------------
+    import time as _time
+
+    from paddle_tpu.observability import aggregate as agg
+    from paddle_tpu.observability import liveness as lv
+    lv_mon = lv.enable(start=False)
+    try:
+        lv.declare_beacon("test.ratchet_stall", "ratchet driver")
+        monkeypatch.setenv(
+            "PADDLE_TPU_LIVENESS_DEADLINE_TEST_RATCHET_STALL", "0.0")
+        with lv.beacon("test.ratchet_stall"):
+            _time.sleep(0.005)
+            assert lv_mon.check_now()       # liveness.stalls{beacon=}
+    finally:
+        lv.disable()
+
+    def _host_doc(host, p50):
+        return {"format": "paddle_tpu-telemetry-v1", "host": host,
+                "pid": 1, "wall_ts": _time.time(), "beacons": {},
+                "step_times": {"train.step_seconds": {
+                    "count": 8, "sum": p50 * 8, "p50": p50,
+                    "p95": p50, "p99": p50}},
+                "stalls": {}, "metrics": {}}
+
+    merged = agg.merge_docs({0: _host_doc(0, 0.1), 1: _host_doc(1, 0.4)},
+                            2)              # liveness.straggler{host=}
+    assert merged["stragglers"] == [1]
+
     snap = reg.snapshot()
     undeclared = set(snap) - set(CATALOG)
     assert not undeclared, "runtime metrics missing from catalog: %s" % (
@@ -366,7 +394,8 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     # metric objects existing): counters with observed activity
     for name in ("serving.prefix_hit_pages", "serving.cow_copies",
                  "serving.preemptions", "serving.spec_proposed_tokens",
-                 "serving.collective_bytes",
+                 "serving.collective_bytes", "liveness.stalls",
+                 "liveness.straggler",
                  "train.amp_skipped_steps", "train.divergence_rollbacks"):
         total = sum(s.get("value", s.get("count", 0))
                     for s in snap[name]["series"])
