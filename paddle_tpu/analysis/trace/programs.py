@@ -203,8 +203,13 @@ def _build_serving() -> List[TraceProgram]:
       donation-aliased continuous-batching iteration over the page
       pool; TPU502 verifies the pool donation actually materializes as
       input/output aliasing), ``serving/prefill_chunk`` (the single
-      chunked-prefill program) and ``serving/cow_copy`` (the page
-      copy-on-write step, both pool buffers donated);
+      chunked-prefill program), ``serving/cow_copy`` (the page
+      copy-on-write step, both pool buffers donated), and the
+      disaggregated handoff pair (ISSUE 15) — ``serving/kv_export``
+      (page gather into the dense transfer buffer; TPU502 confirms the
+      TRANSFER-BUFFER donation materializes, the buffer is reused every
+      chunk) and ``serving/kv_import`` (scatter into the decode pool;
+      pool donated);
     * slotted (kept for A/B) — ``serving/decode_step_slotted`` and
       ``serving/prefill`` (the smallest bucket);
     * ISSUE 8 modes, COMPOSED (int8 KV + speculative) so the audit
@@ -234,6 +239,12 @@ def _build_serving() -> List[TraceProgram]:
              paged.prefill_chunk_trace_args()),
             ("serving/cow_copy", paged._cow_fn,
              paged._cow_donate_argnums, paged.cow_trace_args()),
+            ("serving/kv_export", paged._kv_export_fn,
+             paged._kv_export_donate_argnums,
+             paged.kv_export_trace_args()),
+            ("serving/kv_import", paged._kv_import_fn,
+             paged._kv_import_donate_argnums,
+             paged.kv_import_trace_args()),
             ("serving/decode_step_slotted", slotted._decode_fn,
              slotted._decode_donate_argnums, slotted.decode_trace_args()),
             ("serving/prefill", slotted._prefill_fn,

@@ -95,6 +95,20 @@ CATALOG = {
         "PADDLE_TPU_METRICS_COLLECTIVES=1 at engine construction; "
         "first step pays one AOT compile for the price)"),
 
+    # -- disaggregated prefill/decode handoff (serving/disagg.py — ISSUE 15)
+    "serving.handoff_bytes": _m(
+        "counter", "KV bytes moved from a prefill engine's pool into a "
+        "decode engine's pool by disaggregated page handoffs (K+V rows "
+        "across all layers, int8 scale rows included — kv_row_bytes "
+        "truth per transferred page)", unit="bytes"),
+    "serving.handoff_seconds": _m(
+        "histogram", "wall time of one handoff chunk (export -> stage "
+        "-> import of up to handoff_pages pages), interleaved between "
+        "decode steps", unit="seconds"),
+    "serving.handoff_queue_depth": _m(
+        "gauge", "requests queued for or mid KV handoff (the bounded "
+        "handoff queue plus in-flight transfers)"),
+
     # -- serving front-end (serving/frontend.py — ISSUE 13) -----------------
     "serving.http_requests": _m(
         "counter", "HTTP requests by response status code (200 stream/"

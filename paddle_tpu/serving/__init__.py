@@ -33,7 +33,7 @@ __all__ = [
     "sample", "spec_accept", "propose", "TOP_K_MAX", "DecodeEngine",
     "ContinuousBatchingScheduler", "Request", "RequestResult",
     "PrefillTask", "InflightDecode", "ServingFrontend", "generate",
-    "engine_for",
+    "engine_for", "DisaggScheduler", "HandoffTask",
 ]
 
 _LAZY = {
@@ -45,6 +45,8 @@ _LAZY = {
     "Request": ("paddle_tpu.serving.scheduler", "Request"),
     "RequestResult": ("paddle_tpu.serving.scheduler", "RequestResult"),
     "ServingFrontend": ("paddle_tpu.serving.frontend", "ServingFrontend"),
+    "DisaggScheduler": ("paddle_tpu.serving.disagg", "DisaggScheduler"),
+    "HandoffTask": ("paddle_tpu.serving.disagg", "HandoffTask"),
 }
 
 

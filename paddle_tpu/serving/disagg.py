@@ -1,0 +1,672 @@
+"""Disaggregated prefill/decode serving (ISSUE 15 — ROADMAP 3(iii)).
+
+Chunked prefill (PR 7) bounds how long one admission can stall
+in-flight TPOT, but prefill compute still time-shares the decode chip:
+under a prefill-heavy mix every chunk slice steals a decode step and
+p99 TPOT degrades with QPS.  The production answer (DistServe /
+Splitwise) is **role separation** — dedicated prefill workers hand
+finished KV off to decode workers, so TTFT scales with prefill capacity
+while decode TPOT stays flat regardless of the prompt-length mix.  This
+module is that architecture in static-shape TPU-native form:
+
+* The **prefill engine** (its own :class:`~.engine.DecodeEngine`,
+  typically pinned to its own chip via ``device=``) runs bucketed/
+  chunked prefill into its OWN paged pool and samples the first token —
+  TTFT is prefill-complete, exactly like the colocated engine.
+* The request's mapped pages then move to the **decode engine** through
+  two static programs: ``kv_export`` (gather the pages into a dense
+  donated transfer buffer on the prefill side) and ``kv_import``
+  (scatter the staged buffer into freshly allocated pages of the decode
+  pool) — ``handoff_pages`` pages per chunk, one chunk per scheduler
+  iteration, interleaved *between* decode steps so an in-flight handoff
+  never blocks a decode dispatch (the import donates the in-flight
+  step's output pool and the device sequences it; same overlap
+  discipline as the PR-12 one-step-in-flight loop).
+* The transfer stages device-to-device via ``jax.device_put`` across
+  the two engines' meshes; the **host-staging fallback**
+  (``via_host=True`` / ``PADDLE_TPU_HANDOFF_HOST=1``) round-trips the
+  chunk through a spilled ``.npz`` on the host — the transport
+  stand-in for disjoint meshes / separate processes, and the natural
+  home of the ``serve.handoff`` chaos site's ``TornFile`` injection.
+
+**Routing.**  Admission is strict FIFO: the queue head routes to the
+prefill engine unless the DECODE pool's prefix cache already covers the
+whole prompt (n-1 tokens — then it admits decode-side in one 1-token
+chunk, skipping prefill AND transfer entirely).  Prefix-cache
+registration happens on the decode side at handoff completion — the
+pool that lives long — so repeated prompts stop paying the transfer;
+the prefill pool keeps its own (engine-native) registration so repeated
+prompts also prefill in fewer chunks.
+
+**Failure/pressure discipline.**  A failed handoff chunk (an injected
+``SocketReset``/``TornFile`` at the ``serve.handoff`` faultpoint, or a
+real transport error) REQUEUES the request at the queue front — the
+recompute path, pages freed refcount-exactly on BOTH pools — instead of
+dropping it.  Decode-pool pressure mid-handoff picks victims exactly
+like PR 7's page-pressure path (refcount-aware, requeue-at-front,
+``max_preemptions``-capped), and a mid-handoff victim cleans up both
+pools.  A wedged transfer trips the ``serve.handoff`` liveness beacon —
+a stall dump with all-thread stacks, not silence.
+
+**Parity.**  Greedy output is BIT-IDENTICAL to the colocated engine:
+the chunk programs are the same programs, the transfer copies page
+bytes exactly (int8 codes + scales included), and per-slot decode math
+is independent of batch composition.  Compile-once holds per role
+(prefill: ``prefill_chunk`` + ``kv_export``; decode: ``decode``/
+``spec_verify`` + ``kv_import`` — each budget 1 under the strict
+watchdog).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import zipfile
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import liveness as _liveness
+from ..observability import registry as _metrics
+from ..observability import tracing as _tracing
+from ..robustness.faultpoints import declare as _declare, faultpoint
+from .engine import PagePoolExhausted, PrefillTask
+from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
+
+__all__ = ["DisaggScheduler", "HandoffTask"]
+
+#: chaos site on the per-chunk page transfer: a scheduled SocketReset
+#: (device path) or TornFile (host-staging path — ctx carries the spill
+#: file's ``path``) simulates a torn transport mid-handoff; the
+#: scheduler must requeue the request, never drop it
+HANDOFF_SITE = _declare(
+    "serve.handoff",
+    "fires once per disaggregated KV handoff chunk (device path: before "
+    "the export dispatch; host-staging path: between spill write and "
+    "read-back, ctx['path'] = the spill file, so TornFile models a torn "
+    "transport)")
+
+#: liveness beacon over one handoff chunk transfer: a wedged device_put
+#: or spill read produces a stall dump naming this beacon
+_liveness.declare_beacon(
+    "serve.handoff",
+    "one disaggregated KV handoff chunk (export -> stage -> import), "
+    "interleaved between decode steps", deadline=600.0)
+
+#: transport errors a handoff chunk treats as "the transfer failed —
+#: requeue and recompute" (ConnectionResetError is an OSError; EOFError/
+#: ValueError/BadZipFile are what reading a torn spill file raises)
+_TRANSPORT_ERRORS = (OSError, EOFError, ValueError, zipfile.BadZipFile)
+
+
+class HandoffTask:
+    """One in-progress KV page handoff: the request finished prefill on
+    the prefill engine and its pages are moving (chunk by chunk) into
+    the decode pool.  ``dst_slot`` is None while the task waits in the
+    bounded handoff queue for a free decode slot."""
+
+    __slots__ = ("act", "ids", "src_slot", "dst_slot", "pages",
+                 "n_pages", "pos", "bytes", "span")
+
+    def __init__(self, act, ids, src_slot, pages):
+        self.act = act
+        self.ids = np.asarray(ids, np.int32)
+        self.src_slot = int(src_slot)
+        self.dst_slot: Optional[int] = None
+        self.pages: List[int] = list(pages)   # prefill-pool page ids,
+        self.n_pages = len(self.pages)        # page-table order
+        self.pos = 0                          # pages transferred
+        self.bytes = 0
+        self.span = None                      # "handoff" request span
+
+
+class DisaggScheduler(ContinuousBatchingScheduler):
+    """Role-split continuous batching: ``engine`` decodes,
+    ``prefill_engine`` prefills, and finished KV hands off between
+    their pools.  Everything else — the overlapped decode loop,
+    refcount-aware eviction, recompute preemption, tracing, streaming
+    hooks — is the base scheduler, so the decode role behaves exactly
+    like the colocated engine once a request's pages have landed."""
+
+    def __init__(self, engine, prefill_engine, handoff_limit=4,
+                 via_host=None, tracer=None, overlap=None, on_token=None,
+                 on_finish=None):
+        if prefill_engine is engine:
+            raise ValueError("disaggregated serving needs TWO engines "
+                             "(prefill_engine is the decode engine)")
+        for e, role in ((engine, "decode"), (prefill_engine, "prefill")):
+            if not e.paged:
+                raise ValueError("%s engine must be paged (the slotted "
+                                 "layout has no page pool to hand off)"
+                                 % role)
+        if prefill_engine.spec_k:
+            raise ValueError("the prefill engine never decodes — build "
+                             "it with spec_k=0")
+        if prefill_engine.tp != 1:
+            raise ValueError("tensor-parallel prefill is not supported "
+                             "(shard the decode engine; prefill is "
+                             "per-slot work)")
+        for attr in ("page_size", "max_len", "handoff_pages",
+                     "kv_dtype", "_cache_dtype", "_layers", "_heads",
+                     "_head_dim"):
+            a, b = getattr(prefill_engine, attr), getattr(engine, attr)
+            if a != b:
+                raise ValueError(
+                    "prefill/decode engine geometry differs on %s: "
+                    "%r vs %r (pages are copied byte-wise between the "
+                    "pools)" % (attr.lstrip("_"), a, b))
+        if prefill_engine.mesh is not None and engine.mesh is None:
+            raise ValueError(
+                "a device-pinned prefill engine needs a mesh-placed "
+                "decode engine (device= or tp=): a meshless engine's "
+                "world is uncommitted, and staging a committed buffer "
+                "into it would split its jit caches on commitment")
+        super().__init__(engine, tracer=tracer, overlap=overlap,
+                         on_token=on_token, on_finish=on_finish)
+        self.prefill_engine = prefill_engine
+        self.handoff_limit = int(handoff_limit)
+        if self.handoff_limit < 1:
+            raise ValueError("handoff_limit must be >= 1")
+        if via_host is None:
+            via_host = os.environ.get("PADDLE_TPU_HANDOFF_HOST",
+                                      "0") == "1"
+        self.via_host = bool(via_host)
+        self.pslots: List[Optional[object]] = \
+            [None] * prefill_engine.num_slots
+        self._ready: deque = deque()          # HandoffTasks, bounded
+        self._handoffs: Dict[int, HandoffTask] = {}   # dst_slot -> task
+        self._blocked_stamp = None            # admit()'s capacity-block
+                                              # memo (see admit)
+        # handoff accounting (the bench's per-line report)
+        self.handoff_bytes_total = 0
+        self.handoffs_total = 0
+        # role-routing accounting (the bench's structural isolation
+        # gate): every decode-side chunk must be a single-chunk
+        # full-prefix-hit admission — real prefill compute only ever
+        # runs on the prefill engine
+        self.decode_route_admissions = 0
+        self.decode_side_chunks = 0
+        self.prefill_side_chunks = 0
+        self._m_ho_bytes = _metrics.counter("serving.handoff_bytes")
+        self._m_ho_secs = _metrics.histogram("serving.handoff_seconds")
+        self._m_ho_depth = _metrics.gauge("serving.handoff_queue_depth")
+        self._ho_beacon = _liveness.beacon("serve.handoff")
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def handoff_depth(self) -> int:
+        """Requests queued for or mid-transfer (the bounded queue plus
+        the in-flight set)."""
+        return len(self._ready) + len(self._handoffs)
+
+    def has_work(self) -> bool:
+        return (super().has_work()
+                or any(a is not None for a in self.pslots)
+                or bool(self._ready))
+
+    def _set_depth(self):
+        self._m_ho_depth.set(self.handoff_depth)
+
+    # -- admission routing -------------------------------------------------
+
+    def _decode_covers(self, ids) -> bool:
+        """True when the DECODE pool's prefix cache covers the whole
+        prompt (n-1 tokens after the cap): the request admits
+        decode-side in one 1-token chunk — no prefill, no transfer."""
+        _pages, covered = self.engine._alloc.lookup_prefix(ids)
+        return covered >= int(np.asarray(ids).size) - 1
+
+    def _free_decode_slot(self) -> Optional[int]:
+        for idx, a in enumerate(self.slots):
+            if a is None:
+                return idx
+        return None
+
+    def admit(self) -> int:
+        """Strict-FIFO admission with role routing: the queue head goes
+        to the prefill engine unless the decode pool's prefix cache
+        fully covers it (then it admits decode-side directly).  A head
+        whose route has no free slot blocks the queue — FIFO order is
+        never reordered around capacity."""
+        n = 0
+        while self.waiting:
+            idx = self._free_decode_slot()
+            pidx = next((i for i, a in enumerate(self.pslots)
+                         if a is None), None)
+            if idx is None and pidx is None:
+                # both routes full: no admission is possible, so don't
+                # hash the head's prompt (coverage lookup is O(prompt)
+                # host work) on every iteration of the decode hot loop
+                break
+            req = self.waiting[0]
+            parked = self._preempted.get(req.rid)
+            ids = req.prompt
+            if parked is not None and parked.generated:
+                ids = np.concatenate(
+                    [ids, np.asarray(parked.generated, np.int32)])
+            # capacity-block memo: if the same head blocked last
+            # iteration with the same free-route shape and the same
+            # prefix-cache state (handoff completions and decode-route
+            # admissions are the only events that register new decode-
+            # side prefixes), the coverage lookup — O(prompt) host
+            # hashing — would repeat last iteration's answer; skip it
+            # on the hot loop.  Any component changing re-evaluates.
+            stamp = (req.rid, int(np.asarray(ids).size), idx is None,
+                     pidx is None, self.handoffs_total,
+                     self.decode_route_admissions)
+            if stamp == self._blocked_stamp:
+                break
+            if self._decode_covers(ids):
+                if idx is None:
+                    self._blocked_stamp = stamp
+                    break
+                self.waiting.popleft()
+                self.decode_route_admissions += 1
+                self._admit_paged(idx, req)
+            else:
+                if pidx is None:
+                    self._blocked_stamp = stamp
+                    break
+                self.waiting.popleft()
+                self._admit_paged(pidx, req,
+                                  engine=self.prefill_engine,
+                                  slots=self.pslots)
+            self._blocked_stamp = None
+            n += 1
+        if n:
+            self._m_queue_depth.set(len(self.waiting))
+            self._m_occupancy.set(
+                sum(a is not None for a in self.slots))
+        return n
+
+    # -- prefill side ------------------------------------------------------
+
+    def prefill_once(self) -> int:
+        n = super().prefill_once()      # decode-side tasks (full hits)
+        self.decode_side_chunks += n
+        pn = self._prefill_side_once()
+        self.prefill_side_chunks += pn
+        self._handoff_advance()
+        return n + pn
+
+    def _evict_prefill_pages(self, requester_pidx: int) -> str:
+        """Prefill-pool pressure: preempt the prefill-side slot with the
+        most unshared pages (excluding the requester), requeueing it at
+        the queue front like the decode-side path.  Returns ``"retry"``
+        (pages were freed), ``"wait"`` (the only other occupants are
+        mid-handoff — their pages free when the transfers land, so the
+        requester parks instead of dying), or ``"retired"`` (the
+        requester itself was the last occupant and cannot fit alone —
+        finished cache_full)."""
+        candidates = [i for i, a in enumerate(self.pslots)
+                      if a is not None and i != requester_pidx
+                      and isinstance(a.prefill_task, PrefillTask)]
+        if not candidates:
+            if any(a is not None for i, a in enumerate(self.pslots)
+                   if i != requester_pidx):
+                return "wait"
+            self._finish_pslot(requester_pidx, "cache_full")
+            return "retired"
+        victim = max(candidates,
+                     key=lambda i: (
+                         self.prefill_engine.unshared_pages(i),
+                         -self.pslots[i].admit_order))
+        act = self.pslots[victim]
+        rid = act.req.rid
+        cnt = self._preempt_count.get(rid, 0) + 1
+        self._preempt_count[rid] = cnt
+        if cnt > self.max_preemptions:
+            self._finish_pslot(victim, "cache_full")
+            return "retry"
+        self.pslots[victim] = None
+        self.prefill_engine.free_slot(victim)
+        act.prefill_task = None
+        self._requeue_front(act, "preempted", slot=victim)
+        return "retry"
+
+    def _requeue_front(self, act, event, **attrs):
+        """Park ``act`` and put its request back at the FRONT of the
+        waiting queue (preemption / handoff-abort recompute path)."""
+        rid = act.req.rid
+        self.waiting.appendleft(act.req)
+        self._submit_t[rid] = act.submit_t
+        self._preempted[rid] = act
+        root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
+        root.event(event, **attrs)
+        self._wait_spans[rid] = self._tracer.span("requeue", parent=root,
+                                                  rework=True)
+        self._m_preempt.inc()
+        self._m_queue_depth.set(len(self.waiting))
+
+    def _finish_pslot(self, pidx: int, reason: str):
+        """Retire a request that never reached the decode engine (EOS or
+        budget on its first token, prefill-side cache_full, cancel)."""
+        act = self.pslots[pidx]
+        self.pslots[pidx] = None
+        self.prefill_engine.free_slot(pidx)
+        task = act.prefill_task
+        act.prefill_task = None
+        if isinstance(task, HandoffTask) and task in self._ready:
+            self._ready.remove(task)
+            self._set_depth()
+        self._retire(act, reason)
+
+    def _prefill_side_once(self) -> int:
+        """Advance every prefill-engine admission by ONE chunk.  Chunks
+        dispatch with ``sync=False``: the final chunk's sampled token is
+        POLLED (``is_ready()``) on later iterations, never blocked on —
+        a prefill-engine program must not stall the decode loop's next
+        dispatch (the role-isolation contract; the colocated baseline
+        keeps its synchronous chunk loop)."""
+        n = 0
+        pe = self.prefill_engine
+        for pidx, act in enumerate(self.pslots):
+            if act is None or not isinstance(act.prefill_task,
+                                             PrefillTask):
+                continue
+            task = act.prefill_task
+            if task.done:
+                # final chunk dispatched on an earlier iteration: poll
+                # its token / retry a queue-full handoff
+                self._after_final_chunk(pidx)
+                continue
+
+            def evict(pidx=pidx):
+                # "retry" freed pages; "retired" / "wait" give up (the
+                # slot parks — the next iteration retries after the
+                # in-flight transfers freed pages)
+                return self._evict_prefill_pages(pidx) == "retry"
+
+            done = self._run_prefill_chunk(act, task, pe, evict,
+                                           sync=False)
+            if done is None:
+                continue
+            n += 1
+            if done:
+                self._after_final_chunk(pidx)
+        return n
+
+    def _after_final_chunk(self, pidx: int):
+        """The final chunk is dispatched: once its sampled token is
+        READY (polled between decode steps, never a blocking sync) emit
+        it — TTFT is prefill-complete, the colocated contract — and
+        queue the handoff, or retire outright when one token already
+        ends the request (no transfer for a max_new_tokens=1 /
+        instant-EOS prompt)."""
+        act = self.pslots[pidx]
+        task = act.prefill_task
+        if task.first_token < 0:
+            dev = task.first_token_dev
+            if dev is not None and not dev.is_ready():
+                return              # not landed yet: poll next iteration
+            task.first_token = int(dev)
+            task.first_token_dev = None
+            now = time.perf_counter()
+            rid = act.req.rid
+            root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
+            if act.first_tok_t is None:
+                root.event("first_token")
+            act.first_token(task.first_token, now)
+            self._notify_tokens(rid, act.generated[-1:])
+            # one token may already end the request — retire on the
+            # prefill side, the decode pool never hears about it
+            req = act.req
+            tok = act.generated[-1]
+            if (req.eos_token_id is not None
+                    and tok == int(req.eos_token_id)):
+                self._finish_pslot(pidx, "eos")
+                return
+            if len(act.generated) >= req.max_new_tokens:
+                self._finish_pslot(pidx, "length")
+                return
+        self._try_queue_handoff(pidx)
+
+    def _try_queue_handoff(self, pidx: int) -> bool:
+        """Move a prefill-complete slot into the bounded handoff queue;
+        False (slot stays parked, pages held — backpressure on prefill
+        capacity) when the queue is full."""
+        if len(self._ready) >= self.handoff_limit:
+            return False
+        act = self.pslots[pidx]
+        task = act.prefill_task
+        pe = self.prefill_engine
+        pages = [int(p) for p in
+                 pe._alloc.table[pidx][pe._alloc.mapped[pidx]]]
+        ho = HandoffTask(act, task.ids, pidx, pages)
+        act.prefill_task = ho
+        self._ready.append(ho)
+        self._set_depth()
+        return True
+
+    # -- the handoff itself ------------------------------------------------
+
+    def _handoff_advance(self):
+        """Start queued handoffs into free decode slots, then advance
+        every in-flight handoff by ONE chunk — between decode steps, so
+        a transfer never blocks a decode dispatch."""
+        while self._ready:
+            idx = self._free_decode_slot()
+            if idx is None:
+                break
+            task = self._ready.popleft()
+            task.dst_slot = idx
+            self.slots[idx] = task.act
+            self._handoffs[idx] = task
+            root = self._req_spans.get(task.act.req.rid,
+                                       _tracing.NOOP_SPAN)
+            task.span = self._tracer.span("handoff", parent=root,
+                                          pages=task.n_pages)
+            self._set_depth()
+        for idx in list(self._handoffs):
+            task = self._handoffs.get(idx)
+            if task is None:
+                # retired mid-loop: an earlier chunk's page-pressure
+                # eviction (or cap retirement) picked this mid-handoff
+                # slot as its victim and popped it via _preempt/_finish
+                continue
+            self._handoff_chunk(task)
+
+    def _spill_roundtrip(self, bufs, rid, chunk_idx):
+        """The host-staging transport: spill the chunk to a ``.npz``,
+        fire the chaos site with the file path (TornFile truncates it —
+        a torn transport), read it back.  Raises the transport error a
+        torn/reset transfer produces.
+
+        npz cannot round-trip ml_dtypes (a bfloat16 pool saves as void
+        ``|V2`` and reloads unusable — which stage_handoff would raise
+        on and the abort path would MISREAD as a torn transport): non-
+        numpy-native dtypes spill as a byte-exact unsigned view and the
+        read-back restores the dtype."""
+        names = ("k", "v", "ks", "vs")
+        arrays, dtypes = {}, {}
+        for n, a in zip(names, bufs):
+            if a is None:
+                continue
+            a = np.asarray(a)
+            dtypes[n] = a.dtype
+            if a.dtype.kind not in "fiu":
+                a = a.view("u%d" % a.dtype.itemsize)
+            arrays[n] = a
+        fd, path = tempfile.mkstemp(suffix=".npz",
+                                    prefix="paddle_tpu_handoff_")
+        os.close(fd)
+        try:
+            np.savez(path, **arrays)
+            faultpoint(HANDOFF_SITE, rid=rid, chunk=chunk_idx, path=path)
+            with np.load(path) as doc:
+                out = []
+                for n in names:
+                    if n not in doc.files:
+                        out.append(None)
+                        continue
+                    a = doc[n]
+                    if a.dtype != dtypes[n]:
+                        a = a.view(dtypes[n])
+                    out.append(a)
+                return tuple(out)
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _handoff_chunk(self, task: HandoffTask):
+        """Move ONE chunk of ``task``'s pages: export on the prefill
+        engine, stage across, allocate + map decode pages, import.
+        Transport errors (the ``serve.handoff`` chaos site included)
+        abort the whole handoff and requeue the request at the queue
+        front; decode-pool pressure evicts refcount-aware first."""
+        pe, de = self.prefill_engine, self.engine
+        rid = task.act.req.rid
+        chunk = task.pages[task.pos:task.pos + pe.handoff_pages]
+        chunk_idx = task.pos // pe.handoff_pages
+        with self._ho_beacon:
+            t0 = time.perf_counter()
+            try:
+                if self.via_host:
+                    bufs = self._spill_roundtrip(
+                        pe.export_pages(chunk), rid, chunk_idx)
+                    staged = de.stage_handoff(bufs)
+                else:
+                    faultpoint(HANDOFF_SITE, rid=rid, chunk=chunk_idx)
+                    bufs = pe.export_pages(chunk)
+                    try:
+                        staged = de.stage_handoff(bufs)
+                    except (ValueError, RuntimeError):
+                        # meshes the runtime cannot bridge device-to-
+                        # device (disjoint backends/processes): switch
+                        # this scheduler to the host-staging transport
+                        # for the rest of the run and retry the chunk
+                        self.via_host = True
+                        bufs = self._spill_roundtrip(bufs, rid,
+                                                     chunk_idx)
+                        staged = de.stage_handoff(bufs)
+            except _TRANSPORT_ERRORS as e:
+                self._handoff_abort(task, e)
+                return
+            dst = self._alloc_dst(task, len(chunk))
+            if dst is None:
+                return            # requester retired (cache_full)
+            de.import_pages(staged, dst)
+            de._m_pool.set(de._alloc.pages_used())
+            task.pos += len(chunk)
+            moved = pe.handoff_chunk_bytes(len(chunk))
+            task.bytes += moved
+            self.handoff_bytes_total += moved
+            self._m_ho_bytes.inc(moved)
+            self._m_ho_secs.observe(time.perf_counter() - t0)
+        if task.pos >= task.n_pages:
+            self._handoff_finish(task)
+
+    def _alloc_dst(self, task: HandoffTask, n: int):
+        """Allocate + map ``n`` fresh decode-pool pages for the chunk,
+        evicting decode-side victims under pressure (in-flight step
+        drained first — PR-7 discipline).  None when the handoff itself
+        was retired by the eviction fallback."""
+        de = self.engine
+        while True:
+            ids, failed = [], False
+            try:
+                for _ in range(n):
+                    ids.append(de._alloc.alloc())
+            except PagePoolExhausted:
+                failed = True
+            if not failed:
+                break
+            for pid in ids:
+                de._alloc._release(pid)
+            if self._drain_inflight():
+                continue
+            if not self._evict_for_pages(task.dst_slot):
+                return None     # requester finished cache_full
+            if task.dst_slot not in self._handoffs:
+                return None     # eviction machinery retired the task
+        for i, pid in enumerate(ids):
+            de._alloc.map(task.dst_slot, task.pos + i, pid)
+        return ids
+
+    def _handoff_finish(self, task: HandoffTask):
+        """All pages landed: publish the decode-side length mirror,
+        register the prompt in the DECODE pool's prefix cache (the pool
+        that lives long — later identical prompts skip prefill AND
+        transfer), release the prefill-side slot, and activate the
+        decode slot."""
+        de, act = self.engine, task.act
+        n = int(task.ids.size)
+        de._set_length(task.dst_slot, n)
+        act.cache_len = n
+        de._alloc.register_prefix(task.dst_slot, task.ids)
+        self._handoffs.pop(task.dst_slot, None)
+        act.prefill_task = None
+        self.pslots[task.src_slot] = None
+        self.prefill_engine.free_slot(task.src_slot)
+        if task.span is not None:
+            task.span.end(bytes=task.bytes, pages=task.pos)
+            task.span = None
+        self.handoffs_total += 1
+        self._set_depth()
+        self._check_finished(task.dst_slot)
+
+    def _handoff_abort(self, task: HandoffTask, exc):
+        """A chunk's transport failed: free BOTH pools refcount-exactly
+        and requeue the request at the queue front for recompute (the
+        ``max_preemptions`` cap still bounds a persistently torn
+        transport — then it finishes "cache_full" like any
+        eviction-starved request)."""
+        act = task.act
+        rid = act.req.rid
+        if task.dst_slot is not None:
+            self._handoffs.pop(task.dst_slot, None)
+            self.slots[task.dst_slot] = None
+            self.engine.free_slot(task.dst_slot)
+        self.pslots[task.src_slot] = None
+        self.prefill_engine.free_slot(task.src_slot)
+        act.prefill_task = None
+        if task.span is not None:
+            task.span.end(aborted=True, error=type(exc).__name__)
+            task.span = None
+        self._set_depth()
+        cnt = self._preempt_count.get(rid, 0) + 1
+        self._preempt_count[rid] = cnt
+        if cnt > self.max_preemptions:
+            self._retire(act, "cache_full")
+            return
+        self._requeue_front(act, "handoff_aborted",
+                            error=type(exc).__name__)
+
+    # -- lifecycle overrides (a decode slot may be mid-handoff) ------------
+
+    def _release_handoff_src(self, idx: int):
+        task = self._handoffs.pop(idx, None)
+        if task is None:
+            return
+        self.pslots[task.src_slot] = None
+        self.prefill_engine.free_slot(task.src_slot)
+        if task.span is not None:
+            task.span.end(aborted=True)
+            task.span = None
+        self._set_depth()
+
+    def _finish(self, idx: int, reason: str):
+        self._release_handoff_src(idx)
+        super()._finish(idx, reason)
+
+    def _preempt(self, idx: int):
+        self._release_handoff_src(idx)
+        super()._preempt(idx)
+
+    def cancel(self, rid: int) -> bool:
+        if rid in self.finished:
+            return False
+        for pidx, act in enumerate(self.pslots):
+            if act is None or act.req.rid != rid:
+                continue
+            task = act.prefill_task
+            if isinstance(task, HandoffTask) and task.dst_slot is not None:
+                break           # mid-transfer: the decode-slot scan
+                                # below cleans both sides (_finish)
+            self._finish_pslot(pidx, "cancelled")
+            return True
+        return super().cancel(rid)

@@ -196,6 +196,7 @@ class PrefillTask:
     chunks_run: int = 0
     done: bool = False
     first_token: int = -1                 # sampled by the FINAL chunk
+    first_token_dev: object = None        # () device array (sync=False)
     last_logits: object = None            # (vocab,) device array
 
 
@@ -208,7 +209,8 @@ class DecodeEngine:
                  min_bucket=16, seed=0, top_k_max=TOP_K_MAX, donate=True,
                  paged=True, page_size=64, num_pages=None,
                  prefill_chunk=None, kv_dtype=None, spec_k=0,
-                 spec_ngram=3, tracer=None, tp=1):
+                 spec_ngram=3, tracer=None, tp=1, device=None,
+                 handoff_pages=4):
         cfg = model.config
         self.model = model
         # request-scoped tracing (ISSUE 9): the engine lane carries one
@@ -279,6 +281,14 @@ class DecodeEngine:
         self.mesh = None
         self._param_shard_specs = {}
         self._entry_shardings = {}
+        if device is not None and self.tp > 1:
+            raise ValueError(
+                "device= pins a SINGLE-chip engine; tp > 1 engines pick "
+                "their own devices (the first tp of jax.devices())")
+        if device is not None and not self.paged:
+            raise ValueError(
+                "device= runs on the paged engine (the slotted layout "
+                "is the single-chip A/B baseline)")
         if self.tp > 1:
             devices = jax.devices()
             if len(devices) < self.tp:
@@ -298,6 +308,16 @@ class DecodeEngine:
             # with_sharding_constraint sites (incl. the head constraints
             # in the cache walk) resolve the serving topology
             self.mesh = Mesh(np.asarray(devices[:self.tp]), (MP_AXIS,))
+        elif device is not None:
+            # device pinning (ISSUE 15): a 1-device ('mp',) mesh commits
+            # the pool, the parameters, and every entry's outputs to the
+            # GIVEN device through the same jit-with-shardings machinery
+            # the tp path uses (single-device jit outputs are uncommitted
+            # in this jax, so "create the buffers there" would not
+            # survive the first call) — role-split disaggregated serving
+            # places its prefill engine on its own chip this way
+            self.mesh = Mesh(np.asarray([device]), (MP_AXIS,))
+        if self.mesh is not None:
             self._param_shard_specs = self._collect_param_specs()
             self.state = self._shard_state(self.state)
         self._base_key = jax.random.key(int(seed))
@@ -331,7 +351,7 @@ class DecodeEngine:
         self.spec_stats = {"steps": 0, "proposed": 0, "accepted": 0}
         if self.paged:
             self._init_paged(cfg, page_size, num_pages, prefill_chunk,
-                             donate)
+                             donate, handoff_pages)
         else:
             self._init_slotted(cfg, min_bucket, donate)
         # black-box flight recorder: dumps collect this engine's state
@@ -399,8 +419,10 @@ class DecodeEngine:
         training, ``functional_state`` leaves are committed to their
         training placement, and feeding them to the sharded entries'
         ``in_shardings`` raises a device-assignment mismatch instead of
-        silently resharding (the ``refresh_state`` regression)."""
-        if self.tp <= 1:
+        silently resharding (the ``refresh_state`` regression).  The
+        identity for meshless engines; device-pinned (1-device mesh)
+        engines place the tree on their device the same way."""
+        if self.mesh is None:
             return state
         sh = {k: NamedSharding(self.mesh, self._param_shard_specs[k])
               for k in state}
@@ -525,7 +547,7 @@ class DecodeEngine:
     # ------------------------------------------------------------------
 
     def _init_paged(self, cfg, page_size, num_pages, prefill_chunk,
-                    donate):
+                    donate, handoff_pages=4):
         self.page_size = min(int(page_size), self.max_len)
         self.max_pages = -(-self.max_len // self.page_size)
         # default pool: capacity parity with the slotted layout (every
@@ -536,6 +558,15 @@ class DecodeEngine:
         self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
                                  else min(64, self.max_len))
         self.prompt_cap = self.max_len
+        # disaggregated prefill/decode handoff (ISSUE 15): pages move
+        # between role-split engines' pools through ONE fixed-size
+        # transfer buffer of `handoff_pages` pages — a fixed chunk shape
+        # keeps kv_export/kv_import each a single static program, and
+        # the scheduler interleaves chunks between decode steps
+        self.handoff_pages = max(1, min(int(handoff_pages),
+                                        self.max_pages))
+        self._handoff_buf = None       # lazily allocated, donated in
+                                       # place by every kv_export call
         self._alloc = PageAllocator(self.num_pages, self.num_slots,
                                     self.max_pages, self.page_size,
                                     tracer=self._tracer)
@@ -549,10 +580,12 @@ class DecodeEngine:
             self.num_pages, self._layers, self.page_size, self._heads,
             self._head_dim, self.num_slots, self.max_pages,
             self._cache_dtype, kv_dtype=self._kv_dtype_arg())
-        if self.tp > 1:
+        if self.mesh is not None:
             # the pool lives HEAD-SHARDED from birth: each chip holds
             # 1/tp of the KV bytes (the whole point), and the sharded
-            # entries' donated aliasing needs matching input placement
+            # entries' donated aliasing needs matching input placement.
+            # A device-pinned engine (1-device mesh) takes the same path
+            # — 'sharding' there just means committed placement.
             c = self.cache
             pool = self._sh(None, None, None, MP_AXIS, None)
             scale = self._sh(None, None, None, MP_AXIS)
@@ -670,6 +703,44 @@ class DecodeEngine:
                                                        start[:-1])
             return cache_k, cache_v, k_scale, v_scale
 
+        def kv_export_fn(cache_k, cache_v, k_scale, v_scale, buf_k,
+                         buf_v, buf_ks, buf_vs, page_ids):
+            """Gather up to ``handoff_pages`` pool pages (all layers,
+            scale rows included for the int8 pool) into the dense
+            transfer buffer — the prefill side of a disaggregated
+            handoff.  The buffer operands are DONATED: every chunk
+            reuses the same storage instead of allocating a fresh
+            multi-page buffer per transfer (TPU502 verifies the
+            aliasing materializes).  ``page_ids`` entries past the
+            valid count are padded with 0 — they gather page 0's bytes,
+            which the import side's scatter drops."""
+            ids = jnp.asarray(page_ids, jnp.int32)
+            # plain [] gather keeps the index math i32 (the PR-1
+            # embedding-gather discipline); ids are host-validated
+            out_k = cache_k[ids]
+            out_v = cache_v[ids]
+            out_ks = out_vs = None
+            if quantized:
+                out_ks = k_scale[ids]
+                out_vs = v_scale[ids]
+            return out_k, out_v, out_ks, out_vs
+
+        def kv_import_fn(cache_k, cache_v, k_scale, v_scale, buf_k,
+                         buf_v, buf_ks, buf_vs, dst_ids):
+            """Scatter a staged transfer buffer into freshly allocated
+            pages of THIS pool — the decode side of a disaggregated
+            handoff.  The pool operands are donated (in-place update,
+            like every other entry); ``dst_ids`` pad entries carry
+            ``num_pages``, an out-of-bounds id the default scatter mode
+            drops (the paged_scatter discipline)."""
+            ids = jnp.asarray(dst_ids, jnp.int32)
+            cache_k = cache_k.at[ids].set(buf_k)
+            cache_v = cache_v.at[ids].set(buf_v)
+            if quantized:
+                k_scale = k_scale.at[ids].set(buf_ks)
+                v_scale = v_scale.at[ids].set(buf_vs)
+            return cache_k, cache_v, k_scale, v_scale
+
         q = self._quantized
         self._decode_fn = decode_fn
         self._decode_donate_argnums = \
@@ -682,7 +753,13 @@ class DecodeEngine:
         self._cow_fn = cow_copy_fn
         self._cow_donate_argnums = \
             ((0, 1) + ((2, 3) if q else ())) if donate else ()
-        if self.tp > 1:
+        self._kv_export_fn = kv_export_fn
+        self._kv_export_donate_argnums = \
+            ((4, 5) + ((6, 7) if q else ())) if donate else ()
+        self._kv_import_fn = kv_import_fn
+        self._kv_import_donate_argnums = \
+            ((0, 1) + ((2, 3) if q else ())) if donate else ()
+        if self.mesh is not None:
             # every entry's SHARDED TWIN is the same traced fn jitted
             # with explicit in/out shardings: pool (+ scale pools)
             # head-sharded, everything that varies per step replicated.
@@ -699,6 +776,12 @@ class DecodeEngine:
             state_sh = self._state_shardings()
             decode_in = (state_sh, pool, pool, scale, scale, rep, rep,
                          rep, rep, rep, rep, rep, rep)
+            # the handoff transfer buffer shares the pool's head layout
+            # (axis 3), so a tp engine's export/import moves only its
+            # own head shard; on a 1-device (pinned) mesh it is simply
+            # committed placement
+            ho_in = (pool, pool, scale, scale, pool, pool, scale, scale,
+                     rep)
             self._entry_shardings = {
                 "serving.decode": (
                     decode_in,
@@ -713,6 +796,8 @@ class DecodeEngine:
                 "serving.cow_copy": (
                     (pool, pool, scale, scale, rep, rep),
                     (pool, pool, scale, scale)),
+                "serving.kv_export": (ho_in, (pool, pool, scale, scale)),
+                "serving.kv_import": (ho_in, (pool, pool, scale, scale)),
             }
 
         def _jit(entry, fn, donate_argnums):
@@ -744,6 +829,20 @@ class DecodeEngine:
             "serving.cow_copy",
             _jit("serving.cow_copy", cow_copy_fn,
                  self._cow_donate_argnums),
+            expected=1)
+        # fixed chunk shape => ONE program each for the disaggregated
+        # page handoff (ISSUE 15): export on the prefill role, import on
+        # the decode role — an engine that never hands off never
+        # compiles them (the jit objects are free)
+        self._kv_export = watch(
+            "serving.kv_export",
+            _jit("serving.kv_export", kv_export_fn,
+                 self._kv_export_donate_argnums),
+            expected=1)
+        self._kv_import = watch(
+            "serving.kv_import",
+            _jit("serving.kv_import", kv_import_fn,
+                 self._kv_import_donate_argnums),
             expected=1)
 
     # -- host-side API -----------------------------------------------------
@@ -795,7 +894,7 @@ class DecodeEngine:
             self._len_host[:] = 0
             self._m_pool.set(0)
             lengths = jnp.zeros((self.num_slots,), jnp.int32)
-            if self.tp > 1:
+            if self.mesh is not None:
                 # keep the lengths COMMITTED-replicated like every other
                 # call's (init device_puts, the sharded entries' outputs
                 # are committed): jit keys on commitment, so a fresh
@@ -977,11 +1076,18 @@ class DecodeEngine:
                            top_k=int(top_k), top_p=float(top_p),
                            shared_tokens=covered, shared_pages=n_map)
 
-    def prefill_step(self, task: PrefillTask) -> bool:
+    def prefill_step(self, task: PrefillTask, sync: bool = True) -> bool:
         """Run ONE chunk of an admission; returns True when the prompt
         is fully prefilled (``task.first_token``/``task.last_logits``
         are then set).  Raises PagePoolExhausted when the chunk's pages
-        cannot be mapped — the scheduler evicts a victim and retries."""
+        cannot be mapped — the scheduler evicts a victim and retries.
+
+        ``sync=False`` leaves the final chunk's sampled token as the
+        DEVICE array ``task.first_token_dev`` instead of blocking on
+        ``int(tok)`` — the disaggregated scheduler polls
+        ``.is_ready()`` between decode steps so a prefill-engine chunk
+        never stalls a decode dispatch (the role-isolation contract);
+        the colocated path keeps the synchronous default."""
         if task.done:
             return True
         n = int(task.ids.size)
@@ -1030,7 +1136,10 @@ class DecodeEngine:
         self._len_host[task.slot] = task.pos
         if task.pos >= n:
             task.done = True
-            task.first_token = int(tok)
+            if sync:
+                task.first_token = int(tok)
+            else:
+                task.first_token_dev = tok
             task.last_logits = logits
             # publish this prompt's pages for later admissions to share
             self._alloc.register_prefix(task.slot, task.ids)
@@ -1097,7 +1206,7 @@ class DecodeEngine:
         if isinstance(tokens, jax.Array):
             return jnp.reshape(tokens, (self.num_slots, 1))
         toks = np.asarray(tokens, np.int32).reshape(self.num_slots, 1)
-        if self.tp > 1:
+        if self.mesh is not None:
             return jax.device_put(toks, self._sh())
         return jnp.asarray(toks)
 
@@ -1232,7 +1341,7 @@ class DecodeEngine:
         else:
             toks = np.asarray(tokens, np.int32).reshape(S, 1)
             step_toks = np.concatenate([toks, drafts_np], axis=1)
-            if self.tp > 1:                     # see _token_operand
+            if self.mesh is not None:           # see _token_operand
                 step_toks = jax.device_put(step_toks, self._sh())
         tr_on = self._tracer.enabled
         if tr_on:
@@ -1337,6 +1446,137 @@ class DecodeEngine:
             tokens, drafts, active, temperature, top_k, top_p,
             pages_ready=pages_ready))
 
+    # -- disaggregated prefill/decode handoff (ISSUE 15) -------------------
+
+    def _require_paged(self, what):
+        if not self.paged:
+            raise RuntimeError("%s is a paged-engine operation (the "
+                               "slotted layout has no page pool)" % what)
+
+    def _handoff_buf_shapes(self):
+        H = self.handoff_pages
+        pool = (H, self._layers, self.page_size, self._heads,
+                self._head_dim)
+        return pool, pool[:-1]
+
+    def _new_handoff_buf(self):
+        """A fresh transfer buffer (k, v, k_scale, v_scale) placed like
+        the pool (committed onto the engine mesh when there is one, so
+        the donated aliasing has matching input placement)."""
+        pool_shape, scale_shape = self._handoff_buf_shapes()
+        bk = jnp.zeros(pool_shape, self.cache.k.dtype)
+        bv = jnp.zeros(pool_shape, self.cache.v.dtype)
+        bks = bvs = None
+        if self._quantized:
+            bks = jnp.zeros(scale_shape, jnp.float32)
+            bvs = jnp.zeros(scale_shape, jnp.float32)
+        if self.mesh is not None:
+            psh = self._sh(None, None, None, MP_AXIS, None)
+            ssh = self._sh(None, None, None, MP_AXIS)
+            bk = jax.device_put(bk, psh)
+            bv = jax.device_put(bv, psh)
+            if self._quantized:
+                bks = jax.device_put(bks, ssh)
+                bvs = jax.device_put(bvs, ssh)
+        return [bk, bv, bks, bvs]
+
+    def export_pages(self, page_ids):
+        """Gather up to ``handoff_pages`` pool pages into the engine's
+        persistent (donated-in-place) transfer buffer — the prefill
+        role's half of a disaggregated handoff.  Returns the
+        ``(k, v, k_scale, v_scale)`` device arrays; rows past
+        ``len(page_ids)`` hold pad garbage the import side drops.  The
+        returned arrays ARE the persistent buffer: stage them onto the
+        decode engine (``stage_handoff``) before the next export call
+        donates the storage again (device execution order makes an
+        already-dispatched stage safe)."""
+        self._require_paged("export_pages")
+        n = len(page_ids)
+        if not 0 < n <= self.handoff_pages:
+            raise ValueError("export_pages moves 1..%d pages per chunk, "
+                             "got %d" % (self.handoff_pages, n))
+        ids = np.zeros((self.handoff_pages,), np.int32)
+        ids[:n] = np.asarray(page_ids, np.int32)
+        if self._handoff_buf is None:
+            self._handoff_buf = self._new_handoff_buf()
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._kv_export.compile_count
+            t0_ns = time.perf_counter_ns()
+        with x64_scope(False), self._trace_scope():
+            out = self._kv_export(self.cache.k, self.cache.v,
+                                  *self._cache_scale_args(),
+                                  *self._handoff_buf, jnp.asarray(ids))
+        if tr_on:
+            self._dispatch_span("engine.kv_export", self._kv_export,
+                                t0_ns, c0)
+        self._handoff_buf = list(out)
+        return tuple(out)
+
+    def stage_handoff(self, bufs):
+        """Place a peer engine's exported transfer buffer onto THIS
+        engine's devices (``jax.device_put`` — device-to-device when the
+        runtime can, committed to this engine's mesh placement so the
+        import's in_shardings accept it).  ``bufs`` may be device arrays
+        (the direct path) or host numpy arrays (the host-staging
+        fallback the scheduler uses when the meshes are disjoint).
+
+        Meshless engines do NOT ``device_put``: their whole world is
+        uncommitted (single-device jit outputs are uncommitted in this
+        jax), and a committed buffer would propagate commitment through
+        the import's donated pool and split the decode jit cache on the
+        next step — the PR-11 reset lesson.  A meshless engine therefore
+        only accepts buffers already on its (default) device; the
+        scheduler validates the engine pairing at construction."""
+        self._require_paged("stage_handoff")
+        if self.mesh is None:
+            # same-device handoff: device arrays pass through untouched,
+            # host arrays (the staging fallback) lift uncommitted
+            return tuple(None if a is None
+                         else (a if isinstance(a, jax.Array)
+                               else jnp.asarray(a))
+                         for a in bufs)
+        psh = self._sh(None, None, None, MP_AXIS, None)
+        ssh = self._sh(None, None, None, MP_AXIS)
+        return tuple(None if a is None else jax.device_put(a, t)
+                     for a, t in zip(bufs, (psh, psh, ssh, ssh)))
+
+    def import_pages(self, bufs, dst_page_ids):
+        """Scatter a staged transfer buffer into THIS pool at
+        ``dst_page_ids`` (freshly allocated page ids — the decode role's
+        half of a handoff; the caller owns the allocator bookkeeping
+        that mapped them).  Pool buffers are donated: the in-flight
+        decode step's outputs are consumed in place and the next
+        dispatch sees the imported pages — no host sync."""
+        self._require_paged("import_pages")
+        n = len(dst_page_ids)
+        if not 0 < n <= self.handoff_pages:
+            raise ValueError("import_pages lands 1..%d pages per chunk, "
+                             "got %d" % (self.handoff_pages, n))
+        # pad with num_pages: an out-of-bounds id the scatter DROPS
+        ids = np.full((self.handoff_pages,), self.num_pages, np.int32)
+        ids[:n] = np.asarray(dst_page_ids, np.int32)
+        c = self.cache
+        tr_on = self._tracer.enabled
+        if tr_on:
+            c0 = self._kv_import.compile_count
+            t0_ns = time.perf_counter_ns()
+        with x64_scope(False), self._trace_scope():
+            k, v, ks, vs = self._kv_import(
+                c.k, c.v, *self._cache_scale_args(), *bufs,
+                jnp.asarray(ids))
+        if tr_on:
+            self._dispatch_span("engine.kv_import", self._kv_import,
+                                t0_ns, c0)
+        self.cache = PagedKVCache(k, v, c.page_table, c.lengths,
+                                  k_scale=ks, v_scale=vs)
+
+    def handoff_chunk_bytes(self, n_pages):
+        """Bytes ``n_pages`` transferred pages move (K+V rows, scale
+        rows included — ``kv_row_bytes`` truth), for the handoff
+        accounting."""
+        return int(n_pages) * self.page_size * self.kv_row_bytes()
+
     def slot_lengths(self):
         """Per-slot valid lengths.  Paged mode serves the host mirror —
         no device->host sync on the scheduler's per-iteration path."""
@@ -1422,6 +1662,10 @@ class DecodeEngine:
         }
         if self.paged:
             al = self._alloc
+            st["compile_counts"]["kv_export"] = \
+                int(self._kv_export._cache_size())
+            st["compile_counts"]["kv_import"] = \
+                int(self._kv_import._cache_size())
             st.update(
                 num_pages=self.num_pages,
                 page_size=self.page_size,
@@ -1513,6 +1757,22 @@ class DecodeEngine:
         return (self.cache.k, self.cache.v, *self._cache_scale_args(),
                 jnp.zeros((), jnp.int32), jnp.ones((), jnp.int32))
 
+    def kv_export_trace_args(self):
+        """Argument avals for the handoff export entry (fresh zero
+        buffers, NOT the live persistent one — lowering an audit must
+        not race a real handoff's donated storage)."""
+        self._require_paged("kv_export_trace_args")
+        return (self.cache.k, self.cache.v, *self._cache_scale_args(),
+                *self._new_handoff_buf(),
+                jnp.zeros((self.handoff_pages,), jnp.int32))
+
+    def kv_import_trace_args(self):
+        self._require_paged("kv_import_trace_args")
+        return (self.cache.k, self.cache.v, *self._cache_scale_args(),
+                *self._new_handoff_buf(),
+                jnp.full((self.handoff_pages,), self.num_pages,
+                         jnp.int32))
+
     # -- cost reports (ISSUE 11) -------------------------------------------
 
     def cost_reports(self, only=None):
@@ -1535,6 +1795,12 @@ class DecodeEngine:
                             self.prefill_chunk_trace_args()))
             entries.append(("serving.cow_copy", self._cow_fn,
                             self._cow_donate_argnums, self.cow_trace_args()))
+            entries.append(("serving.kv_export", self._kv_export_fn,
+                            self._kv_export_donate_argnums,
+                            self.kv_export_trace_args()))
+            entries.append(("serving.kv_import", self._kv_import_fn,
+                            self._kv_import_donate_argnums,
+                            self.kv_import_trace_args()))
             if self.spec_k:
                 entries.append(("serving.spec_verify", self._verify_fn,
                                 self._verify_donate_argnums,

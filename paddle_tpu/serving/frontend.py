@@ -133,7 +133,8 @@ class ServingFrontend:
     an ephemeral port (read :attr:`port` after :meth:`start`)."""
 
     def __init__(self, engine, host="127.0.0.1", port=0, queue_limit=64,
-                 overlap=None, guard=None, tracer=None):
+                 overlap=None, guard=None, tracer=None,
+                 prefill_engine=None, handoff_limit=4):
         self.engine = engine
         self.host = host
         self.port = int(port)
@@ -141,9 +142,19 @@ class ServingFrontend:
         self._guard = guard
         self._tracer = (tracer if tracer is not None
                         else _tracing.default_tracer())
-        self.scheduler = ContinuousBatchingScheduler(
-            engine, tracer=tracer, overlap=overlap,
-            on_token=self._on_token, on_finish=self._on_finish)
+        if prefill_engine is not None:
+            # disaggregated prefill/decode (ISSUE 15): admissions route
+            # to the prefill engine and finished KV hands off into the
+            # decode pool; the HTTP surface is unchanged
+            from .disagg import DisaggScheduler
+            self.scheduler = DisaggScheduler(
+                engine, prefill_engine, handoff_limit=handoff_limit,
+                tracer=tracer, overlap=overlap,
+                on_token=self._on_token, on_finish=self._on_finish)
+        else:
+            self.scheduler = ContinuousBatchingScheduler(
+                engine, tracer=tracer, overlap=overlap,
+                on_token=self._on_token, on_finish=self._on_finish)
         # command queues (handler threads -> scheduler thread)
         self._lock = threading.Lock()
         self._pending = []                # [(Request, _Stream)]
@@ -276,9 +287,7 @@ class ServingFrontend:
                 for rid in cancels:
                     sched.cancel(rid)
                 worked = False
-                if (sched.waiting
-                        or any(a is not None for a in sched.slots)
-                        or sched._inflight is not None):
+                if sched.has_work():
                     sched.step()
                     worked = True
                 else:
@@ -421,6 +430,10 @@ class ServingFrontend:
                     "queue_depth": len(self.scheduler.waiting),
                     "slots_active": sum(
                         a is not None for a in self.scheduler.slots),
+                    # disaggregated schedulers also expose the handoff
+                    # pipeline depth (0 when absent/colocated)
+                    "handoff_depth": getattr(self.scheduler,
+                                             "handoff_depth", 0),
                 })
                 return
             if method != "POST" or path != "/v1/generate":

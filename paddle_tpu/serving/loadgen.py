@@ -26,8 +26,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["MIXES", "run_load", "run_load_sync", "summarize",
-           "percentile"]
+__all__ = ["MIXES", "run_load", "run_load_sync", "run_interference",
+           "run_interference_sync", "summarize", "percentile"]
 
 #: named prompt/output length mixes: (prompt_len_range, max_new_range),
 #: both inclusive.  Lengths are drawn uniformly per request from the
@@ -37,6 +37,16 @@ MIXES = {
     "short": ((8, 16), (4, 8)),
     "mixed": ((8, 48), (4, 16)),
     "long": ((32, 96), (8, 32)),
+    # the interference worst case (ISSUE 15): long prompts, short
+    # outputs — almost all of the request's compute is prefill, so a
+    # wave of these steals the most decode iterations from a colocated
+    # engine (the disaggregated A/B's admission wave)
+    "prefill_heavy": ((64, 112), (2, 4)),
+    # its counterpart: short prompts, long outputs — streams that live
+    # long enough to BE in flight when the wave lands, so their
+    # inter-token gaps sample exactly the decode-TPOT interference the
+    # A/B measures (the steady stream of the isolation drive)
+    "decode_heavy": ((8, 16), (24, 48)),
 }
 
 
@@ -50,13 +60,19 @@ def percentile(values: List[float], q: float) -> float:
     return float(v[idx])
 
 
-async def _one_request(host: str, port: int, payload: dict) -> dict:
+async def _one_request(host: str, port: int, payload: dict,
+                       record_gaps: bool = False) -> dict:
     """POST one streaming generate and consume its SSE events.  Returns
     {status, ttft, tpot, tokens, finish_reason} — ttft/tpot are None
-    when no token arrived (shed, error)."""
+    when no token arrived (shed, error).  ``record_gaps=True`` also
+    collects ``gaps``: one ``(arrival_time, gap_seconds)`` per
+    post-first token event — the per-token samples the interference A/B
+    classifies into quiet-vs-wave windows."""
     t0 = time.perf_counter()
     rec = {"status": 0, "ttft": None, "tpot": None, "tokens": 0,
            "finish_reason": None}
+    if record_gaps:
+        rec["gaps"] = []
     try:
         reader, writer = await asyncio.open_connection(host, port)
     except OSError:
@@ -98,6 +114,10 @@ async def _one_request(host: str, port: int, payload: dict) -> dict:
                 now = time.perf_counter()
                 if first_t is None:
                     first_t = now
+                elif record_gaps:
+                    # one sample per EVENT (a speculative run delivers
+                    # several tokens at once): gap amortized per token
+                    rec["gaps"].append((now, (now - last_t) / k))
                 last_t = now
                 n += k
         rec["tokens"] = n
@@ -156,6 +176,131 @@ async def run_load(host: str, port: int, qps: float, n_requests: int,
 def run_load_sync(host, port, qps, n_requests, **kw) -> dict:
     """:func:`run_load` from synchronous code (its own event loop)."""
     return asyncio.run(run_load(host, port, qps, n_requests, **kw))
+
+
+async def run_interference(host: str, port: int, qps: float,
+                           n_requests: int, mix="short",
+                           wave_mix="prefill_heavy", wave_n: int = 4,
+                           wave_qps: float = 8.0, seed: int = 0,
+                           vocab: int = 256,
+                           temperature: float = 0.0,
+                           repeats: int = 1) -> dict:
+    """The interference-isolation A/B drive (ISSUE 15): a steady Poisson
+    stream of ``mix`` requests, plus a concurrent **admission wave** of
+    ``wave_n`` ``wave_mix`` (long-prompt) requests offered at
+    ``wave_qps`` starting once the steady stream is warm (~1/3 through).
+    Every steady-stream token event records its inter-token gap with a
+    timestamp; the summary classifies gaps into the **quiet** window vs
+    the **wave** window (first wave request sent → last wave stream
+    done), so ``wave_tpot_p99_ms / quiet_tpot_p99_ms`` measures exactly
+    how much a long-prompt admission wave degrades IN-FLIGHT decode
+    TPOT — flat for a disaggregated engine, inflated for the colocated
+    chunked-prefill baseline.  Seeded like :func:`run_load`: a rerun
+    offers the identical workload.
+
+    ``repeats`` runs the whole steady+wave cycle that many times and
+    POOLS the gap samples (per-cycle wave windows): a p99 over one
+    cycle's ~10² wave-window gaps is essentially the max of the set and
+    flaps on a single OS hiccup; pooling 3 cycles' samples makes the
+    isolation gate CI-stable.  ``repeats=1`` is byte-identical to the
+    pre-repeat behavior (cycle r>0 reseeds at ``seed + 1000*r``)."""
+    loop = asyncio.get_running_loop()
+    (plo, phi), (nlo, nhi) = MIXES[mix] if isinstance(mix, str) else mix
+    (wplo, wphi), (wnlo, wnhi) = (MIXES[wave_mix]
+                                  if isinstance(wave_mix, str) else wave_mix)
+
+    async def _cycle(cycle_seed):
+        rng = np.random.default_rng(cycle_seed)
+        wave_rng = np.random.default_rng(cycle_seed + 1)
+        t_start = loop.time()
+        wave_window = {"t0": None, "t1": None}
+
+        async def _steady():
+            t_next, tasks = 0.0, []
+            for _ in range(int(n_requests)):
+                plen = int(rng.integers(plo, phi + 1))
+                payload = {
+                    "prompt": [int(x) for x in rng.integers(0, vocab,
+                                                            (plen,))],
+                    "max_new_tokens": int(rng.integers(nlo, nhi + 1)),
+                    "temperature": float(temperature),
+                }
+                delay = (t_start + t_next) - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(asyncio.ensure_future(
+                    _one_request(host, port, payload, record_gaps=True)))
+                t_next += float(rng.exponential(1.0 / float(qps)))
+            return await asyncio.gather(*tasks)
+
+        async def _wave():
+            # warm-up: let ~1/3 of the steady stream land first so the
+            # quiet window has samples
+            await asyncio.sleep((n_requests / 3.0) / float(qps))
+            wave_window["t0"] = time.perf_counter()
+            t_next, tasks = 0.0, []
+            w0 = loop.time()
+            for _ in range(int(wave_n)):
+                plen = int(wave_rng.integers(wplo, wphi + 1))
+                payload = {
+                    "prompt": [int(x) for x in wave_rng.integers(
+                        0, vocab, (plen,))],
+                    "max_new_tokens": int(wave_rng.integers(wnlo,
+                                                            wnhi + 1)),
+                    "temperature": float(temperature),
+                }
+                delay = (w0 + t_next) - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                tasks.append(asyncio.ensure_future(
+                    _one_request(host, port, payload)))
+                t_next += float(wave_rng.exponential(
+                    1.0 / float(wave_qps)))
+            out = await asyncio.gather(*tasks)
+            wave_window["t1"] = time.perf_counter()
+            return out
+
+        steady, wave = await asyncio.gather(_steady(), _wave())
+        return steady, wave, wave_window, loop.time() - t_start
+
+    all_steady, quiet, waved = [], [], []
+    wave_sent = wave_done = 0
+    wall = 0.0
+    for rep in range(max(1, int(repeats))):
+        steady, wave, window, cycle_wall = await _cycle(
+            seed + 1000 * rep)
+        wall += cycle_wall
+        all_steady.extend(steady)
+        t0, t1 = window["t0"], window["t1"]
+        for r in steady:
+            for ts, gap in r.get("gaps", ()):
+                (waved if (t0 is not None and t0 <= ts <= t1)
+                 else quiet).append(gap)
+        wave_sent += int(wave_n)
+        wave_done += sum(1 for r in wave if r["status"] == 200
+                         and r["finish_reason"] not in
+                         (None, "error", "connection_error"))
+    summary = summarize(all_steady, wall, qps=float(qps),
+                        mix=(mix if isinstance(mix, str) else "custom"))
+    summary["wave"] = {
+        "mix": (wave_mix if isinstance(wave_mix, str) else "custom"),
+        "requests": wave_sent,
+        "completed": wave_done,
+        "repeats": max(1, int(repeats)),
+        "quiet_gaps": len(quiet),
+        "wave_gaps": len(waved),
+        "quiet_tpot_p50_ms": round(1e3 * percentile(quiet, 0.50), 3),
+        "quiet_tpot_p99_ms": round(1e3 * percentile(quiet, 0.99), 3),
+        "wave_tpot_p50_ms": round(1e3 * percentile(waved, 0.50), 3),
+        "wave_tpot_p99_ms": round(1e3 * percentile(waved, 0.99), 3),
+    }
+    return summary
+
+
+def run_interference_sync(host, port, qps, n_requests, **kw) -> dict:
+    """:func:`run_interference` from synchronous code."""
+    return asyncio.run(run_interference(host, port, qps, n_requests,
+                                        **kw))
 
 
 def summarize(recs: List[dict], wall_s: float, qps: float,

@@ -109,7 +109,7 @@ from ..observability import liveness as _liveness
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
 from ..robustness.faultpoints import declare as _declare, faultpoint
-from .engine import PagePoolExhausted
+from .engine import PagePoolExhausted, PrefillTask
 from .spec import propose as _propose_draft
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
@@ -361,6 +361,15 @@ class ContinuousBatchingScheduler:
 
     def _finish(self, idx: int, reason: str):
         act = self.slots[idx]
+        self.slots[idx] = None
+        self.engine.free_slot(idx)     # paged: pages back to the pool
+        self._retire(act, reason)
+
+    def _retire(self, act: "_ActiveSlot", reason: str):
+        """Result/metric/span bookkeeping of one retiring request —
+        slot-list-free, so the disaggregated scheduler's prefill-side
+        retirements build the SAME RequestResult (one code path for
+        the contract the bench and the front-end consume)."""
         tpot = (act.decode_s / act.decode_steps) if act.decode_steps \
             else 0.0
         # a request evicted before producing any token (cache_full mid-
@@ -382,8 +391,6 @@ class ContinuousBatchingScheduler:
             ws.end()
         self._req_spans.pop(act.req.rid, _tracing.NOOP_SPAN).end(
             reason=reason, tokens=len(act.generated))
-        self.slots[idx] = None
-        self.engine.free_slot(idx)     # paged: pages back to the pool
         self._preempt_count.pop(act.req.rid, None)
         self._m_finished.labels(reason=reason).inc()
         if got_first:
@@ -474,12 +481,14 @@ class ContinuousBatchingScheduler:
 
     # -- admission ---------------------------------------------------------
 
-    def _begin_paged(self, idx: int, req: Request, ids):
+    def _begin_paged(self, idx: int, req: Request, ids, engine=None):
         """Start a chunked-prefill admission of ``ids`` into ``idx`` —
         the one place for the prefill_begin call and its prefix-hit
         metric (fresh admissions and preemption resumes both land
-        here)."""
-        task = self.engine.prefill_begin(
+        here).  ``engine`` defaults to the decode engine; the
+        disaggregated scheduler passes its prefill engine."""
+        engine = self.engine if engine is None else engine
+        task = engine.prefill_begin(
             idx, ids, temperature=req.temperature,
             top_k=req.top_k, top_p=req.top_p)
         if task.shared_pages:
@@ -488,6 +497,56 @@ class ContinuousBatchingScheduler:
                 "prefix_hit", pages=task.shared_pages,
                 tokens=task.shared_tokens)
         return task
+
+    def _admit_paged(self, idx: int, req: Request, engine=None,
+                     slots=None):
+        """Pop-side bookkeeping for ONE paged admission (fresh or
+        preemption resume) into slot ``idx`` of ``slots`` against
+        ``engine`` — defaults are the decode engine/slot list; the
+        disaggregated scheduler routes admissions to its prefill
+        engine through the same path so spans, queue-wait and the
+        resume contract cannot drift between roles.  Returns the
+        (fresh or resumed) :class:`_ActiveSlot`."""
+        engine = self.engine if engine is None else engine
+        slots = self.slots if slots is None else slots
+        submit_t = self._submit_t.pop(req.rid)
+        resumed = self._preempted.pop(req.rid, None)
+        order = self._admit_seq
+        self._admit_seq += 1
+        # close the wait span (initial "queue", or a preemption's
+        # "requeue") and mark the admission on the request lane
+        ws = self._wait_spans.pop(req.rid, None)
+        if ws is not None:
+            ws.end()
+        root = self._req_spans.get(req.rid, _tracing.NOOP_SPAN)
+        root.event("readmitted" if resumed is not None else "admitted",
+                   slot=idx)
+        if resumed is not None:
+            # recompute-resume a preempted request: re-prefill
+            # prompt + generated so the next sampled token continues
+            # the sequence; timing state (ttft, decode_s) and the
+            # token list survive on the parked slot.  queue_wait is
+            # NOT re-observed — one histogram sample per request.
+            ids = req.prompt
+            if resumed.generated:
+                ids = np.concatenate(
+                    [ids, np.asarray(resumed.generated, np.int32)])
+            task = self._begin_paged(idx, req, ids, engine=engine)
+            # keep the per-request field consistent with the
+            # registry counter: resume hits are cache-served work too
+            resumed.prefix_hit_tokens += task.shared_tokens
+            resumed.prefill_task = task
+            resumed.admit_order = order
+            slots[idx] = resumed
+            return resumed
+        admit_t = time.perf_counter()
+        queue_wait = admit_t - submit_t
+        self._m_queue_wait.observe(queue_wait)
+        task = self._begin_paged(idx, req, req.prompt, engine=engine)
+        act = _ActiveSlot(req, submit_t, queue_wait, order,
+                          prefill_task=task)
+        slots[idx] = act
+        return act
 
     def admit(self) -> int:
         """Fill free slots from the waiting queue (FIFO).  Paged engines
@@ -501,65 +560,74 @@ class ContinuousBatchingScheduler:
             req = self.waiting.popleft()
             # a request whose prompt+budget exceeds max_len is still
             # admissible — generation just ends early with "cache_full"
+            if self.engine.paged:
+                self._admit_paged(idx, req)
+                n += 1
+                continue
             submit_t = self._submit_t.pop(req.rid)
-            resumed = self._preempted.pop(req.rid, None)
             order = self._admit_seq
             self._admit_seq += 1
-            # close the wait span (initial "queue", or a preemption's
-            # "requeue") and mark the admission on the request lane
             ws = self._wait_spans.pop(req.rid, None)
             if ws is not None:
                 ws.end()
             root = self._req_spans.get(req.rid, _tracing.NOOP_SPAN)
-            root.event("readmitted" if resumed is not None else "admitted",
-                       slot=idx)
-            if resumed is not None:
-                # recompute-resume a preempted request: re-prefill
-                # prompt + generated so the next sampled token continues
-                # the sequence; timing state (ttft, decode_s) and the
-                # token list survive on the parked slot.  queue_wait is
-                # NOT re-observed — one histogram sample per request.
-                ids = req.prompt
-                if resumed.generated:
-                    ids = np.concatenate(
-                        [ids, np.asarray(resumed.generated, np.int32)])
-                task = self._begin_paged(idx, req, ids)
-                # keep the per-request field consistent with the
-                # registry counter: resume hits are cache-served work too
-                resumed.prefix_hit_tokens += task.shared_tokens
-                resumed.prefill_task = task
-                resumed.admit_order = order
-                self.slots[idx] = resumed
-                n += 1
-                continue
+            root.event("admitted", slot=idx)
             admit_t = time.perf_counter()
             queue_wait = admit_t - submit_t
             self._m_queue_wait.observe(queue_wait)
-            if self.engine.paged:
-                task = self._begin_paged(idx, req, req.prompt)
-                self.slots[idx] = _ActiveSlot(req, submit_t, queue_wait,
-                                              order, prefill_task=task)
-            else:
-                self._m_bucket_hits.labels(
-                    bucket=self.engine.bucket_for(req.prompt.size)).inc()
-                sp = self._tracer.span("prefill", parent=root, slot=idx)
-                tok, _logits = self.engine.prefill(
-                    idx, req.prompt, temperature=req.temperature,
-                    top_k=req.top_k, top_p=req.top_p)
-                sp.end()
-                root.event("first_token")
-                act = _ActiveSlot(req, submit_t, queue_wait, order)
-                act.cache_len = int(req.prompt.size)
-                act.first_token(tok, time.perf_counter())
-                self.slots[idx] = act
-                self._notify_tokens(req.rid, act.generated[-1:])
-                self._check_finished(idx)
+            self._m_bucket_hits.labels(
+                bucket=self.engine.bucket_for(req.prompt.size)).inc()
+            sp = self._tracer.span("prefill", parent=root, slot=idx)
+            tok, _logits = self.engine.prefill(
+                idx, req.prompt, temperature=req.temperature,
+                top_k=req.top_k, top_p=req.top_p)
+            sp.end()
+            root.event("first_token")
+            act = _ActiveSlot(req, submit_t, queue_wait, order)
+            act.cache_len = int(req.prompt.size)
+            act.first_token(tok, time.perf_counter())
+            self.slots[idx] = act
+            self._notify_tokens(req.rid, act.generated[-1:])
+            self._check_finished(idx)
             n += 1
         if n:
             self._m_queue_depth.set(len(self.waiting))
             self._m_occupancy.set(
                 sum(a is not None for a in self.slots))
         return n
+
+    def _run_prefill_chunk(self, act, task, engine, evict, sync=True):
+        """ONE chunked-prefill advance — span selection (recompute
+        chunks after a preemption are REWORK-tagged so the trace
+        analyzer attributes them separately from first-admission
+        prefill; rid stays in _preempt_count until finish), the
+        PagePoolExhausted retry loop, and the chunk-histogram
+        accounting.  Shared by the decode-side loop and the
+        disaggregated scheduler's prefill side so none of that can
+        drift between roles.  ``evict()`` returns True to retry the
+        chunk after freeing pages, False to give up (the requester was
+        retired, or parks to wait).  Returns ``prefill_step``'s
+        ``done``, or None when evict gave up."""
+        rid = act.req.rid
+        root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
+        sp = (self._tracer.span("prefill_chunk", parent=root,
+                                pos=task.pos, rework=True)
+              if rid in self._preempt_count else
+              self._tracer.span("prefill_chunk", parent=root,
+                                pos=task.pos))
+        t0 = time.perf_counter()
+        while True:
+            try:
+                done = engine.prefill_step(task, sync=sync)
+                break
+            except PagePoolExhausted:
+                if not evict():
+                    done = None
+                    break
+        sp.end()
+        if done is not None:
+            self._m_prefill_chunk.observe(time.perf_counter() - t0)
+        return done
 
     def prefill_once(self) -> int:
         """Advance every admitting slot by ONE chunk (the chunked-
@@ -570,44 +638,34 @@ class ContinuousBatchingScheduler:
             if act is None or act.prefill_task is None:
                 continue
             task = act.prefill_task
-            rid = act.req.rid
-            root = self._req_spans.get(rid, _tracing.NOOP_SPAN)
-            # chunks run after a preemption are recompute REWORK (the
-            # re-prefill of prompt + generated) — tagged so the trace
-            # analyzer can attribute them separately from first-admission
-            # prefill (rid stays in _preempt_count until finish)
-            sp = (self._tracer.span("prefill_chunk", parent=root,
-                                    pos=task.pos, rework=True)
-                  if rid in self._preempt_count else
-                  self._tracer.span("prefill_chunk", parent=root,
-                                    pos=task.pos))
-            t0 = time.perf_counter()
-            while True:
-                try:
-                    done = self.engine.prefill_step(task)
-                    break
-                except PagePoolExhausted:
-                    # drain any in-flight decode step FIRST: its
-                    # retirements may free enough pages, and a preempted
-                    # victim must never have an undrained step (the
-                    # parked token list would then lag the device)
-                    if self._drain_inflight():
-                        continue
-                    if not self._evict_for_pages(idx):
-                        done = None    # requester itself was retired
-                        break
-            sp.end()
+            if not isinstance(task, PrefillTask):
+                # a disaggregated handoff parks its (non-chunk) task in
+                # the same field so the slot stays un-decodable; the
+                # disagg scheduler advances it, not this loop
+                continue
+
+            def evict(idx=idx):
+                # drain any in-flight decode step FIRST: its
+                # retirements may free enough pages, and a preempted
+                # victim must never have an undrained step (the
+                # parked token list would then lag the device)
+                if self._drain_inflight():
+                    return True
+                return self._evict_for_pages(idx)
+
+            done = self._run_prefill_chunk(act, task, self.engine,
+                                           evict)
             if done is None:
                 continue
-            now = time.perf_counter()
-            self._m_prefill_chunk.observe(now - t0)
             n += 1
             if done:
                 act.prefill_task = None
                 act.cache_len = int(task.ids.size)
+                root = self._req_spans.get(act.req.rid,
+                                           _tracing.NOOP_SPAN)
                 if act.first_tok_t is None:
                     root.event("first_token")
-                act.first_token(task.first_token, now)
+                act.first_token(task.first_token, time.perf_counter())
                 self._notify_tokens(act.req.rid, act.generated[-1:])
                 self._check_finished(idx)
         return n
@@ -873,8 +931,7 @@ class ContinuousBatchingScheduler:
             n = self.decode_once()
         n += self._drained_n
         self._drained_n = 0
-        if (self._inflight is None and not self.waiting
-                and not any(a is not None for a in self.slots)):
+        if self._inflight is None and not self.has_work():
             # pipeline fully idle with NO backlog (drain end / between
             # traffic): the window until the next dispatch is ARRIVAL
             # time, not host work — charging it would book a load
@@ -889,6 +946,16 @@ class ContinuousBatchingScheduler:
         _hbm.maybe_sample("serving.iteration")
         return n
 
+    def has_work(self) -> bool:
+        """Anything left to drive: waiting requests, occupied slots, or
+        an unconsumed in-flight step.  ``run()`` and the front-end's
+        scheduler thread poll this one predicate (the disaggregated
+        scheduler extends it with its prefill-side and handoff
+        state)."""
+        return bool(self.waiting
+                    or any(a is not None for a in self.slots)
+                    or self._inflight is not None)
+
     def run(self) -> Dict[int, RequestResult]:
         """Drive to completion; returns {rid: RequestResult}.  Always
         terminates: with work pending, admit() either fills a free slot
@@ -902,8 +969,7 @@ class ContinuousBatchingScheduler:
         requester that is the sole occupant is finished, never requeued.
         The overlapped loop adds one tail iteration that only consumes
         the final in-flight step."""
-        while (self.waiting or any(a is not None for a in self.slots)
-               or self._inflight is not None):
+        while self.has_work():
             self.step()
         return self.finished
 

@@ -282,7 +282,8 @@ def test_engine_cost_reports_cover_watched_entries():
     e = _tiny_engine(page_size=16)
     reports = e.cost_reports()
     assert set(reports) == {"serving.decode", "serving.prefill_chunk",
-                            "serving.cow_copy"}
+                            "serving.cow_copy", "serving.kv_export",
+                            "serving.kv_import"}
     for name, r in reports.items():
         assert r.available and r.flops is not None, name
         assert r.peak_bytes and r.peak_bytes > 0, name

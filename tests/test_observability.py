@@ -235,6 +235,20 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     tp_eng.decode([tok, 0], [True, False], [0.0, 0.0], [0, 0],
                   [1.0, 1.0])
 
+    # -- serving D2: disaggregated prefill/decode (ISSUE 15) — one real
+    # role-split drive (prefill engine -> KV page handoff -> decode
+    # engine) fires handoff bytes/seconds and the queue-depth gauge
+    from paddle_tpu.serving.disagg import DisaggScheduler
+    dis_de = DecodeEngine(model, num_slots=2, max_len=64, seed=0,
+                          page_size=8)
+    dis_pe = DecodeEngine(model, num_slots=2, max_len=64, seed=0,
+                          page_size=8)
+    dsched = DisaggScheduler(dis_de, dis_pe)
+    dsched.submit(Request(prompt=rng.integers(0, cfg.vocab_size, (10,)),
+                          max_new_tokens=3, temperature=0.0))
+    dsched.run()
+    assert dsched.handoffs_total >= 1
+
     # -- serving E: the async front-end (ISSUE 13) — one shed (429 +
     # shed_total) then one real streamed completion over HTTP (200,
     # open_streams, goodput_tokens) through the live asyncio server
@@ -1106,6 +1120,64 @@ def test_serve_trajectory_gates_goodput_and_p99_like_for_like(tmp_path):
              _serve_entry(tmp_path, "BENCH_serve_m2.json", 40.0, "tpu",
                           mix="long")]
     assert bs.check_trajectory(mixes) == []
+
+
+def test_serve_line_schema_disagg_and_wave_blocks():
+    """ISSUE-15 optional serve-line fields: a disagg line must carry its
+    handoff bytes, the wave block must be well-formed, and legacy lines
+    without either validate clean (regression)."""
+    bs = _bench_schema()
+    import pytest as _pt
+    # legacy line (no disagg/wave fields) stays valid
+    bs.validate_line(_serve_line(100.0, "cpu"), "<t>")
+    # disagg line with handoff accounting + compile-once handoff entries
+    good = _serve_line(
+        100.0, "cpu", disagg=True, handoff_bytes=4096, handoffs=3,
+        wave={"mix": "prefill_heavy", "requests": 4, "completed": 4,
+              "quiet_gaps": 30, "wave_gaps": 20,
+              "quiet_tpot_p50_ms": 2.0, "quiet_tpot_p99_ms": 4.0,
+              "wave_tpot_p50_ms": 2.1, "wave_tpot_p99_ms": 4.2})
+    good["metrics"]["compile_counts"].update(
+        {"serving.kv_export": 1, "serving.kv_import": 1})
+    bs.validate_line(good, "<t>", ["serving.kv_export",
+                                   "serving.kv_import"])
+    for mutate in (
+        lambda l: l.pop("handoff_bytes"),          # disagg needs bytes
+        lambda l: l.update(handoff_bytes=-1),
+        lambda l: l.update(disagg="yes"),          # not a bool
+        lambda l: l["wave"].pop("wave_tpot_p99_ms"),
+        lambda l: l["wave"].update(quiet_tpot_p50_ms=9.0),  # p50 > p99
+    ):
+        bad = _serve_line(
+            100.0, "cpu", disagg=True, handoff_bytes=4096,
+            wave={"quiet_tpot_p50_ms": 2.0, "quiet_tpot_p99_ms": 4.0,
+                  "wave_tpot_p50_ms": 2.1, "wave_tpot_p99_ms": 4.2})
+        mutate(bad)
+        with _pt.raises(bs.SchemaError):
+            bs.validate_line(bad, "<t>")
+
+
+def test_serve_trajectory_cursor_keys_on_disagg(tmp_path):
+    """ISSUE-15 serve axis: colocated and disagg lines keep separate
+    cursors (a role-split arm is a different operating point), and
+    legacy lines without the field keep their own."""
+    bs = _bench_schema()
+    mixed = [
+        _serve_entry(tmp_path, "BENCH_serve_d1.json", 100.0, "tpu",
+                     disagg=False),
+        _serve_entry(tmp_path, "BENCH_serve_d2.json", 70.0, "tpu",
+                     disagg=True, handoff_bytes=1024),
+        _serve_entry(tmp_path, "BENCH_serve_d3.json", 99.5, "tpu",
+                     disagg=False),
+        # legacy (pre-disagg) line: its own cursor, not the False one
+        _serve_entry(tmp_path, "BENCH_serve_d4.json", 50.0, "tpu"),
+    ]
+    assert bs.check_trajectory(mixed) == []
+    # a like-for-like drop on the disagg leg still fails
+    mixed.append(_serve_entry(tmp_path, "BENCH_serve_d5.json", 60.0,
+                              "tpu", disagg=True, handoff_bytes=1024))
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "BENCH_serve_d2" in fails[0]
 
 
 def test_trajectory_cursor_keys_on_overlap(tmp_path):

@@ -58,11 +58,12 @@ filename), and these gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec, tp, overlap, qps, mix)`` cursor key matches (the
-  ISSUE-8 A/B matrix interleaves quantized/speculative lines in one
-  trajectory, ISSUE 12 adds the ``--tp`` axis, and ISSUE 13 adds the
+  kv_dtype, spec, tp, overlap, disagg, qps, mix)`` cursor key matches
+  (the ISSUE-8 A/B matrix interleaves quantized/speculative lines in
+  one trajectory, ISSUE 12 adds the ``--tp`` axis, ISSUE 13 adds the
   sync-vs-overlapped loop axis plus the serve harness's (QPS, mix)
-  operating points — a tp=2, sync-loop, or qps=16 line must never gate
+  operating points, and ISSUE 15 adds the colocated-vs-disaggregated
+  axis — a tp=2, sync-loop, disagg, or qps=16 line must never gate
   against a different series), a >3% drop in ``value`` fails.  CPU
   entries never perf-gate (smoke numbers), so the gate arms itself
   automatically the first session that records chip numbers;
@@ -205,6 +206,28 @@ def validate_serve_fields(doc: Any, path: str):
              "serve line TPOT percentiles are not ordered (p50<=p99)")
     _require(isinstance(doc.get("mix"), str) and doc.get("mix"), path,
              "serve line 'mix' must be a non-empty string")
+    # ISSUE-15 optional fields: absent on pre-disagg lines (their own
+    # legacy cursor), validated whenever present
+    if "disagg" in doc:
+        _require(isinstance(doc["disagg"], bool), path,
+                 "serve line 'disagg' must be a bool")
+        if doc["disagg"]:
+            _require(_is_num(doc.get("handoff_bytes"))
+                     and doc["handoff_bytes"] >= 0, path,
+                     "a disagg serve line must report non-negative "
+                     "'handoff_bytes'")
+    if "wave" in doc:
+        w = doc["wave"]
+        _require(isinstance(w, dict), path, "'wave' must be an object")
+        for k in ("quiet_tpot_p50_ms", "quiet_tpot_p99_ms",
+                  "wave_tpot_p50_ms", "wave_tpot_p99_ms"):
+            _require(_is_num(w.get(k)) and w[k] >= 0, path,
+                     "wave block field %r must be a non-negative "
+                     "number, got %r" % (k, w.get(k)))
+        _require(w["quiet_tpot_p50_ms"] <= w["quiet_tpot_p99_ms"], path,
+                 "wave block quiet percentiles not ordered (p50<=p99)")
+        _require(w["wave_tpot_p50_ms"] <= w["wave_tpot_p99_ms"], path,
+                 "wave block wave percentiles not ordered (p50<=p99)")
 
 
 def validate_line(doc: Any, path: str,
@@ -312,7 +335,12 @@ _COMPILE_ONCE = {
                               ("top", "decode"),
                               ("top", "verify")),
     SERVE_METRIC: (("metrics", "serving.decode"),
-                   ("metrics", "serving.spec_verify")),
+                   ("metrics", "serving.spec_verify"),
+                   # ISSUE 15: the disaggregated page-handoff programs —
+                   # a second export/import program would mean the fixed
+                   # chunk shape silently varied
+                   ("metrics", "serving.kv_export"),
+                   ("metrics", "serving.kv_import")),
 }
 
 REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
@@ -350,6 +378,7 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "spec": line.get("spec"),
             "tp": line.get("tp"),
             "overlap": line.get("overlap"),
+            "disagg": line.get("disagg"),
             "qps": line.get("qps"),
             "mix": line.get("mix"),
             "ttft_p99_ms": line.get("ttft_p99_ms"),
@@ -397,7 +426,8 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                 continue
             key = (e.get("model"), e.get("cache_layout"),
                    e.get("kv_dtype"), e.get("spec"), e.get("tp"),
-                   e.get("overlap"), e.get("qps"), e.get("mix"))
+                   e.get("overlap"), e.get("disagg"), e.get("qps"),
+                   e.get("mix"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
