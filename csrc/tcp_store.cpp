@@ -246,14 +246,16 @@ class StoreServer {
 
 class StoreClient {
  public:
-  StoreClient(const char* host, int port) {
+  StoreClient(const char* host, int port, int timeout_ms = 30000) {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     ::inet_pton(AF_INET, host, &addr.sin_addr);
-    // retry connect for up to ~30s (server may start later)
-    for (int i = 0; i < 300; i++) {
+    // retry connect until the deadline (server may start later); at
+    // least one attempt even for timeout_ms <= 0
+    int attempts = timeout_ms / 100 + 1;
+    for (int i = 0; i < attempts; i++) {
       if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
         int one = 1;
         ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -342,13 +344,17 @@ void tcp_store_server_destroy(void* server) {
   delete static_cast<StoreServer*>(server);
 }
 
-void* tcp_store_client_create(const char* host, int port) {
-  auto* c = new StoreClient(host, port);
+void* tcp_store_client_create_t(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient(host, port, timeout_ms);
   if (!c->ok()) {
     delete c;
     return nullptr;
   }
   return c;
+}
+
+void* tcp_store_client_create(const char* host, int port) {
+  return tcp_store_client_create_t(host, port, 30000);
 }
 
 void tcp_store_client_destroy(void* client) {

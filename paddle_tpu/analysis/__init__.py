@@ -23,6 +23,11 @@ program registry instead of source text:
 ``python -m paddle_tpu.analysis --trace --strict``.  See the trace
 package docstring for the TPU501-505 catalogue.
 
+A third tier — tpu-race, TPU6xx — lives in :mod:`.concurrency` and runs
+over a package-wide call graph closed from the declared thread roots of
+the serving stack: ``python -m paddle_tpu.analysis --concurrency
+--strict``.  See the concurrency package docstring for TPU601-604.
+
 Programmatic use::
 
     from paddle_tpu.analysis import Analyzer
@@ -41,6 +46,9 @@ from .schema_drift import SchemaDriftPass
 
 from .trace import (TRACE_PASSES, TRACE_RULES, F32_ACCUM_OPS,
                     TraceAnalyzer, TraceProgram)
+from .concurrency import (CONCURRENCY_PASSES, CONCURRENCY_RULES,
+                          ConcurrencyAnalyzer, DEFAULT_REGISTRY,
+                          RoleRegistry)
 
 #: default pass set, in rule-id order.
 ALL_PASSES = [HostSyncPass, X64WideningPass, CollectiveAxisPass,
@@ -53,4 +61,6 @@ __all__ = ["Analyzer", "FileContext", "Finding", "LintPass", "ProjectPass",
            "BaselineFormatError", "HostSyncPass", "X64WideningPass",
            "CollectiveAxisPass", "SchemaDriftPass", "ALL_PASSES", "RULES",
            "S64_COMPUTE_OPS", "TRACE_PASSES", "TRACE_RULES",
-           "F32_ACCUM_OPS", "TraceAnalyzer", "TraceProgram"]
+           "F32_ACCUM_OPS", "TraceAnalyzer", "TraceProgram",
+           "CONCURRENCY_PASSES", "CONCURRENCY_RULES", "ConcurrencyAnalyzer",
+           "DEFAULT_REGISTRY", "RoleRegistry"]

@@ -4,6 +4,12 @@
 * tpu-audit (trace): ``python -m paddle_tpu.analysis --trace [programs]
   [--select TPU504] [--strict]`` — positional args become fnmatch
   patterns over canonical-program names (``'pallas/*'``).
+* tpu-race (concurrency): ``python -m paddle_tpu.analysis --concurrency
+  [paths] [--strict]`` — the TPU6xx call-graph tier over the declared
+  thread roles (paths scope the scanned tree, default ``paddle_tpu``).
+
+``--select`` filters rules within the chosen tier; ``--list-rules``
+prints the unified catalogue (rule, pass, tier, summary) for all three.
 
 ``--format json`` emits one machine-readable JSON document on stdout;
 ``--format github`` emits GitHub workflow annotation lines
@@ -20,7 +26,7 @@ import json
 import os
 import sys
 
-from . import ALL_PASSES, RULES, TRACE_RULES, Analyzer
+from . import ALL_PASSES, CONCURRENCY_RULES, RULES, TRACE_RULES, Analyzer
 from .baseline import BaselineFormatError
 
 
@@ -84,14 +90,19 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="run the trace tier (TPU5xx) over the canonical "
                          "program registry instead of the AST tier")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the concurrency tier (TPU6xx): package-wide "
+                         "call-graph audit from the declared thread roles")
     ap.add_argument("--baseline", default="auto",
                     help="baseline file (default: "
                          "<root>/tools/tpu_lint_baseline.txt if present); "
                          "'none' disables")
     ap.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule ids to run (AST: %s; "
-                         "trace: %s)" % (", ".join(sorted(RULES)),
-                                         ", ".join(sorted(TRACE_RULES))))
+                         "trace: %s; concurrency: %s)"
+                         % (", ".join(sorted(RULES)),
+                            ", ".join(sorted(TRACE_RULES)),
+                            ", ".join(sorted(CONCURRENCY_RULES))))
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
     ap.add_argument("--format", default="text",
@@ -103,11 +114,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rule, cls in sorted(RULES.items()) + sorted(TRACE_RULES.items()):
-            print(f"{rule}  {cls.name:<18} {cls.description}")
+        # one table across all three tiers: rule, pass, tier, summary
+        for tier, cat in (("ast", RULES), ("trace", TRACE_RULES),
+                          ("concurrency", CONCURRENCY_RULES)):
+            for rule, cls in sorted(cat.items()):
+                print(f"{rule}  {cls.name:<18} {tier:<12} "
+                      f"{cls.description}")
         return 0
 
-    catalogue = TRACE_RULES if args.trace else RULES
+    if args.trace and args.concurrency:
+        print("--trace and --concurrency are separate tiers; "
+              "run them as separate invocations", file=sys.stderr)
+        return 2
+
+    catalogue = (TRACE_RULES if args.trace
+                 else CONCURRENCY_RULES if args.concurrency else RULES)
     passes = None
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
@@ -132,6 +153,11 @@ def main(argv=None) -> int:
                 report.errors.append(
                     "trace registry built 0 programs (patterns %r) — an "
                     "empty audit must not pass" % (args.paths,))
+        elif args.concurrency:
+            from .concurrency import ConcurrencyAnalyzer
+            analyzer = ConcurrencyAnalyzer(root=args.root, passes=passes,
+                                           baseline_path=baseline)
+            report = analyzer.run(args.paths or None)
         else:
             analyzer = Analyzer(root=args.root, passes=passes,
                                 baseline_path=baseline)

@@ -87,10 +87,10 @@ class Baseline:
     def subset(self, pred) -> "Baseline":
         """Baseline restricted to entries satisfying ``pred`` (entry
         objects are shared, so 'used' marks survive across subsets).  The
-        AST tier takes the non-TPU5xx entries and the trace tier the
-        TPU5xx ones — each tier's stale report covers only the entries it
-        could ever match, so running one tier never flags the other
-        tier's debt as stale."""
+        trace tier takes the TPU5xx entries, the concurrency tier the
+        TPU6xx ones, and the AST tier everything else — each tier's
+        stale report covers only the entries it could ever match, so
+        running one tier never flags another tier's debt as stale."""
         return Baseline([e for e in self.entries if pred(e)])
 
     def matches(self, finding) -> bool:

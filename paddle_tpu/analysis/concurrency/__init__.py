@@ -1,0 +1,42 @@
+"""tpu-race — tier 3 of the static analysis stack: the concurrency
+audit (rules TPU6xx).
+
+Where tier 1 (tpu-lint) checks each file's AST and tier 2 (tpu-audit)
+checks the traced program, this tier checks the *thread structure* of
+the serving stack: a package-wide call graph (:mod:`.graph`) closed
+over declared thread roots (:mod:`.roles`), with four passes
+(:mod:`.rules`):
+
+=======  ===============================================================
+TPU601   blocking call reachable on the event-loop thread
+TPU602   device→host sync in the decode hot loop outside the
+         allowlisted fetch points (zero-syncs-per-iteration invariant)
+TPU603   attribute written from ≥2 thread roles with an unlocked write
+         and no declared shared_fields reason
+TPU604   blocking op / second lock while holding a lock; Thread sites
+         without daemon=+name= or constructed at import time
+=======  ===============================================================
+
+Run it with ``python -m paddle_tpu.analysis --concurrency --strict``.
+Suppressions are the AST tier's, unchanged: inline
+``# tpu-lint: disable=TPU60x`` or a reasoned entry in
+``tools/tpu_lint_baseline.txt`` (TPU6xx entries are scoped to this
+tier — neither other tier stale-flags them).  See ANALYSIS.md §Tier 3.
+"""
+from .core import ConcurrencyAnalyzer
+from .graph import CallGraph, FnInfo, module_name
+from .roles import DEFAULT_REGISTRY, ROLE_NAMES, RoleRegistry
+from .rules import (ConcurrencyContext, ConcurrencyPass, DecodeSyncPass,
+                    LoopBlockingPass, SharedStatePass, ThreadHygienePass)
+
+CONCURRENCY_PASSES = [LoopBlockingPass, DecodeSyncPass, SharedStatePass,
+                      ThreadHygienePass]
+CONCURRENCY_RULES = {p.rule: p for p in CONCURRENCY_PASSES}
+
+__all__ = [
+    "CONCURRENCY_PASSES", "CONCURRENCY_RULES", "CallGraph",
+    "ConcurrencyAnalyzer", "ConcurrencyContext", "ConcurrencyPass",
+    "DEFAULT_REGISTRY", "DecodeSyncPass", "FnInfo", "LoopBlockingPass",
+    "ROLE_NAMES", "RoleRegistry", "SharedStatePass", "ThreadHygienePass",
+    "module_name",
+]

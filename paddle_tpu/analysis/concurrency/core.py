@@ -1,0 +1,124 @@
+"""The concurrency-tier analyzer: contexts → call graph → role
+closures → passes → :class:`~paddle_tpu.analysis.core.Report`.
+
+Same operational discipline as the other two tiers, with the registry
+as an additional input that must be *coherent* with the tree:
+
+* an empty role registry is an **error** (exit 2), never a green run —
+  an audit with no roots checks nothing;
+* a registry entry whose module IS in the scanned set but whose def no
+  longer exists is **drift** (error): the thread main was renamed and
+  the registry line must move with it in the same PR;
+* entries for modules outside the scanned paths are skipped silently,
+  so targeted runs (``--concurrency paddle_tpu/serving``) stay useful —
+  but if *no* root resolves at all, that is again an error;
+* baseline entries are shared with ``tools/tpu_lint_baseline.txt`` and
+  scoped per-tier: this analyzer loads only TPU6xx entries, so it never
+  stale-flags the AST or trace tiers' lines (and vice versa).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from ..baseline import Baseline
+from ..core import FileContext, Finding, Report, _iter_py_files, \
+    fold_findings
+from .graph import CallGraph
+from .roles import DEFAULT_REGISTRY, RoleRegistry
+from .rules import ConcurrencyContext
+
+__all__ = ["ConcurrencyAnalyzer"]
+
+
+class ConcurrencyAnalyzer:
+    """Run the TPU6xx passes over a file tree."""
+
+    def __init__(self, root: Optional[str] = None, passes=None,
+                 baseline_path: Optional[str] = "auto",
+                 registry: Optional[RoleRegistry] = None):
+        from . import CONCURRENCY_PASSES
+        self.root = os.path.abspath(root or os.getcwd())
+        self.passes = [p() if isinstance(p, type) else p
+                       for p in (passes if passes is not None
+                                 else CONCURRENCY_PASSES)]
+        self.registry = registry if registry is not None else DEFAULT_REGISTRY
+        if baseline_path == "auto":
+            baseline_path = os.path.join(self.root, "tools",
+                                         "tpu_lint_baseline.txt")
+            if not os.path.exists(baseline_path):
+                baseline_path = None
+        base = Baseline.load(baseline_path) if baseline_path \
+            else Baseline([])
+        # only this tier's entries — the AST/trace runs own the rest
+        self.baseline = base.subset(lambda e: e.rule.startswith("TPU6"))
+
+    # -- root resolution -----------------------------------------------------
+    def _resolve_specs(self, graph: CallGraph, specs, label: str,
+                       errors: List[str]):
+        keys = set()
+        for spec in specs:
+            mod = spec.split(":", 1)[0]
+            if mod not in graph.modules:
+                continue        # targeted run: module not in scope
+            key = graph.resolve_root(spec)
+            if key is None:
+                errors.append(
+                    f"role registry drift: {label} entry '{spec}' matches "
+                    f"no definition in the scanned tree — update "
+                    f"analysis/concurrency/roles.py in the same change "
+                    f"that moved it")
+            else:
+                keys.add(key)
+        return keys
+
+    def run(self, paths: Optional[Sequence[str]] = None) -> Report:
+        paths = list(paths) if paths else ["paddle_tpu"]
+        report = Report([], [], [], [], [])
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if not os.path.exists(ap):
+                report.errors.append(f"{p}: path does not exist")
+        if self.registry.empty():
+            report.errors.append(
+                "concurrency role registry is empty — an audit with no "
+                "thread roots checks nothing; refusing a silent green")
+            return report
+
+        contexts: List[FileContext] = []
+        for path in _iter_py_files(paths, self.root):
+            try:
+                contexts.append(FileContext(path, self.root))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                report.errors.append(f"{path}: {e}")
+        report.files = len(contexts)
+
+        graph = CallGraph(contexts)
+        role_roots = {
+            role: self._resolve_specs(graph, specs, f"role '{role}'",
+                                      report.errors)
+            for role, specs in self.registry.roles.items()}
+        if not any(role_roots.values()) and contexts:
+            report.errors.append(
+                "no role roots resolved in the scanned paths — scan the "
+                "package root or fix the registry; refusing a silent green")
+        hot = self._resolve_specs(graph, self.registry.hot_roots,
+                                  "hot_roots", report.errors)
+        fetch = self._resolve_specs(graph, self.registry.fetch_allowlist,
+                                    "fetch_allowlist", report.errors)
+        cc = ConcurrencyContext(
+            graph=graph, registry=self.registry, role_roots=role_roots,
+            role_reach={role: graph.reachable(keys)
+                        for role, keys in role_roots.items()},
+            hot_reach=graph.reachable(hot), fetch_keys=fetch)
+
+        raw: List[Finding] = []
+        seen = set()
+        for pz in self.passes:
+            for f in pz.check(cc):
+                if f not in seen:       # Finding is frozen/hashable
+                    seen.add(f)
+                    raw.append(f)
+        raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        fold_findings(report, raw, contexts, self.baseline)
+        return report

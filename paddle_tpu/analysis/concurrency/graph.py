@@ -1,0 +1,273 @@
+"""Package-wide call graph for the concurrency tier (TPU6xx).
+
+Where TPU101's reachability is intra-file (one ``_Graph`` per
+:class:`~paddle_tpu.analysis.core.FileContext`), the concurrency rules
+need the closure of *thread roots* across the whole package: the
+frontend's scheduler thread calls into ``serving/scheduler.py``, the
+checkpoint writer into ``observability/flight.py``, and a blocking call
+three modules away still blocks the thread that reached it.
+
+The graph is deliberately an **under-approximation** built only from
+edges the AST can prove:
+
+* ``name(...)`` — a nested def in an enclosing scope, a module-level
+  function, or (via the import/alias table) a function in another
+  scanned module;
+* ``self.method(...)`` / ``cls.method(...)`` — resolved through the
+  defining class and its recorded bases, PLUS every override in a
+  scanned subclass (conservative virtual dispatch: the base
+  scheduler's ``self.admit()`` reaches the disaggregated override);
+* ``super().method(...)`` — the first base providing the method;
+* ``module.func(...)`` / ``Class(...)`` — alias-resolved dotted names
+  (a class call edges to its ``__init__`` when one is defined).
+
+Calls through instance attributes of *other* objects
+(``self.engine.prefill_step(...)``) and closures passed as callbacks
+are NOT edges — cross-object thread handoff is declared in the role
+registry instead (:mod:`.roles`), which is the point: the registry is
+the reviewable statement of which code runs on which thread.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import FileContext, ScopedVisitor
+
+__all__ = ["CallGraph", "FnInfo", "module_name"]
+
+
+def module_name(relpath: str) -> str:
+    """Dotted module name for a repo-relative posix path
+    (``a/b/__init__.py`` -> ``a.b``)."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") \
+        else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class FnInfo:
+    """One function/method definition in the scanned set."""
+
+    __slots__ = ("key", "module", "qualname", "cls", "node", "ctx", "raw")
+
+    def __init__(self, key, module, qualname, cls, node, ctx):
+        self.key = key              # "module:qualname"
+        self.module = module
+        self.qualname = qualname    # Finding.symbol
+        self.cls = cls              # innermost enclosing class qualname
+        self.node = node
+        self.ctx = ctx
+        self.raw: List[Tuple] = []  # unresolved call descriptors
+
+
+class _ModuleWalk(ScopedVisitor):
+    """Collect defs, classes (with bases) and raw call sites of one
+    file into the graph's global tables."""
+
+    def __init__(self, ctx: FileContext, module: str, g: "CallGraph"):
+        super().__init__()
+        self.ctx = ctx
+        self.module = module
+        self.g = g
+        self._class_stack: List[str] = []
+        self._fn_stack: List[FnInfo] = []
+
+    # -- defs ----------------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        qual = ".".join(self._scope)
+        dotted = f"{self.module}.{qual}"
+        bases = []
+        for b in node.bases:
+            r = self.ctx.resolve(b)
+            if r:
+                bases.append(r if "." in r else f"{self.module}.{r}")
+        self.g.class_bases[dotted] = bases
+        self._class_stack.append(qual)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._class_stack.pop()
+            self._scope.pop()
+
+    def enter_function(self, node):
+        qual = self.symbol
+        cls = self._class_stack[-1] if self._class_stack else None
+        info = FnInfo(f"{self.module}:{qual}", self.module, qual, cls,
+                      node, self.ctx)
+        self.g.fns[info.key] = info
+        self.g.dotted[f"{self.module}.{qual}"] = info.key
+        if cls is not None and qual == f"{cls}.{node.name}":
+            # a direct method of the class (not a fn nested in a method)
+            self.g.methods[(f"{self.module}.{cls}", node.name)] = info.key
+        self._fn_stack.append(info)
+
+    def leave_function(self, node):
+        self._fn_stack.pop()
+
+    # -- call sites ----------------------------------------------------------
+    def visit_Call(self, node):
+        if self._fn_stack:
+            raw = self._fn_stack[-1].raw
+            f = node.func
+            if isinstance(f, ast.Name):
+                raw.append(("local", tuple(self._scope), f.id))
+                r = self.ctx.resolve(f)
+                if r and "." in r:
+                    raw.append(("dotted", r))
+            elif isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                        and self._class_stack:
+                    raw.append(("selfcall",
+                                f"{self.module}.{self._class_stack[-1]}",
+                                f.attr))
+                elif isinstance(base, ast.Call) \
+                        and self.ctx.resolve(base.func) == "super" \
+                        and self._class_stack:
+                    raw.append(("super",
+                                f"{self.module}.{self._class_stack[-1]}",
+                                f.attr))
+                else:
+                    r = self.ctx.resolve(f)
+                    if r:
+                        raw.append(("dotted", r))
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """The package-wide call graph over a set of parsed contexts."""
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.fns: Dict[str, FnInfo] = {}
+        self.dotted: Dict[str, str] = {}           # module.qualname -> key
+        self.methods: Dict[Tuple[str, str], str] = {}   # (class, name) -> key
+        self.class_bases: Dict[str, List[str]] = {}
+        self.modules: Set[str] = set()
+        self.contexts = list(contexts)
+        for ctx in contexts:
+            mod = module_name(ctx.relpath)
+            self.modules.add(mod)
+            _ModuleWalk(ctx, mod, self).visit(ctx.tree)
+        self._subclasses: Dict[str, Set[str]] = {}
+        for cls, bases in self.class_bases.items():
+            for b in bases:
+                self._subclasses.setdefault(b, set()).add(cls)
+        self.edges: Dict[str, Set[str]] = {}
+        for key, info in self.fns.items():
+            self.edges[key] = self._resolve_calls(info)
+
+    # -- class machinery -----------------------------------------------------
+    def _mro_method(self, cls: str, name: str,
+                    _seen: Optional[Set[str]] = None) -> Optional[str]:
+        if (cls, name) in self.methods:
+            return self.methods[(cls, name)]
+        seen = _seen or set()
+        seen.add(cls)
+        for b in self.class_bases.get(cls, ()):
+            if b not in seen:
+                k = self._mro_method(b, name, seen)
+                if k:
+                    return k
+        return None
+
+    def _all_subclasses(self, cls: str) -> Set[str]:
+        out: Set[str] = set()
+        frontier = [cls]
+        while frontier:
+            c = frontier.pop()
+            for s in self._subclasses.get(c, ()):
+                if s not in out:
+                    out.add(s)
+                    frontier.append(s)
+        return out
+
+    def _self_call_targets(self, cls: str, name: str) -> Set[str]:
+        """Conservative virtual dispatch: the method the class sees via
+        its MRO plus every scanned subclass override."""
+        out: Set[str] = set()
+        k = self._mro_method(cls, name)
+        if k:
+            out.add(k)
+        for sub in self._all_subclasses(cls):
+            if (sub, name) in self.methods:
+                out.add(self.methods[(sub, name)])
+        return out
+
+    # -- edges ---------------------------------------------------------------
+    def _resolve_calls(self, info: FnInfo) -> Set[str]:
+        tgts: Set[str] = set()
+        for desc in info.raw:
+            kind = desc[0]
+            if kind == "dotted":
+                d = desc[1]
+                if d in self.dotted:
+                    tgts.add(self.dotted[d])
+                elif d in self.class_bases:
+                    k = self._mro_method(d, "__init__")
+                    if k:
+                        tgts.add(k)
+            elif kind == "local":
+                _, scope, name = desc
+                chain = list(scope)
+                hit = None
+                while chain:
+                    cand = f"{info.module}:{'.'.join(chain)}.{name}"
+                    if cand in self.fns:
+                        hit = cand
+                        break
+                    chain.pop()
+                if hit is None and f"{info.module}:{name}" in self.fns:
+                    hit = f"{info.module}:{name}"
+                if hit is not None:
+                    tgts.add(hit)
+                else:
+                    d = f"{info.module}.{name}"
+                    if d in self.class_bases:
+                        k = self._mro_method(d, "__init__")
+                        if k:
+                            tgts.add(k)
+            elif kind == "selfcall":
+                _, cls, name = desc
+                tgts |= self._self_call_targets(cls, name)
+            elif kind == "super":
+                _, cls, name = desc
+                for b in self.class_bases.get(cls, ()):
+                    k = self._mro_method(b, name)
+                    if k:
+                        tgts.add(k)
+                        break
+        return tgts
+
+    # -- public API ----------------------------------------------------------
+    def resolve_root(self, spec: str) -> Optional[str]:
+        """``"pkg.module:Qual.name"`` -> function key, following base
+        classes for inherited methods (``DisaggScheduler.step`` resolves
+        to the base implementation; virtual dispatch brings the
+        subclass's overrides back into the closure)."""
+        if ":" not in spec:
+            return None
+        mod, qual = spec.split(":", 1)
+        key = f"{mod}:{qual}"
+        if key in self.fns:
+            return key
+        if "." in qual:
+            cls, name = qual.rsplit(".", 1)
+            cls_dotted = f"{mod}.{cls}"
+            if cls_dotted in self.class_bases:
+                return self._mro_method(cls_dotted, name)
+        return None
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [r for r in roots if r in self.fns]
+        seen.update(frontier)
+        while frontier:
+            k = frontier.pop()
+            for t in self.edges.get(k, ()):
+                if t not in seen:
+                    seen.add(t)
+                    frontier.append(t)
+        return seen

@@ -1,0 +1,169 @@
+"""The declarative thread-role registry for the concurrency tier.
+
+Every rule in this tier reasons from *roots*: functions pinned to the
+thread that really runs them.  The pinning cannot be inferred — a
+``threading.Thread(target=...)`` or an ``on_token=`` callback is a
+runtime value the AST cannot follow — so it is DECLARED here, next to
+the code it describes, and the analyzer fails loudly (exit 2) when an
+entry no longer matches a definition in a scanned module: a renamed
+thread main must update its registry line in the same PR, or the audit
+refuses to pretend it still covers it.
+
+Entry format: ``"pkg.module:Qual.name"`` — the module's dotted path,
+a colon, and the def/class qualname exactly as tpu-lint prints it in
+findings.  Inherited methods resolve through recorded base classes
+(``DisaggScheduler`` entries reach the base scheduler's body, and
+conservative virtual dispatch brings the overrides back in).
+
+Roles (fixed vocabulary — a new kind of thread gets a new role here,
+not an ad-hoc string at a call site):
+
+* ``scheduler``  — the serving scheduler thread: the ONLY caller of the
+  continuous-batching scheduler, plus its callbacks (``_on_token`` /
+  ``_on_finish`` fire on this thread).
+* ``event_loop`` — the frontend's asyncio thread: coroutines and the
+  sync helpers they call.  Blocking here stalls EVERY open stream
+  (rule TPU601).
+* ``writer``     — background IO threads: the async checkpoint writer,
+  the telemetry publisher, the store server's accept/serve threads.
+* ``monitor``    — watchdog/heartbeat threads: the liveness monitor,
+  the elastic heartbeat.
+* ``main``       — the caller-facing API surface of each threaded
+  object (start/stop/save/drain/...): whatever thread owns the object,
+  as opposed to the worker threads it spawns.
+
+``HOT_LOOP_ROOTS`` seeds rule TPU602 separately: the decode hot loop is
+a *subset* of the scheduler role where the bar is stricter — zero
+device syncs per iteration outside ``FETCH_ALLOWLIST`` (the invariant
+PRs 7/12/14 previously proved only by timing).
+
+``SHARED_FIELDS`` is the TPU603 allowlist: attributes deliberately
+written from two roles without a common lock, each with a reason (the
+TPU505 baseline-with-reasons workflow, but in code review's face rather
+than a side file, because the entry documents a concurrency DESIGN, not
+accepted debt).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+__all__ = ["RoleRegistry", "DEFAULT_REGISTRY", "ROLE_NAMES"]
+
+ROLE_NAMES = ("scheduler", "event_loop", "writer", "monitor", "main")
+
+
+@dataclasses.dataclass
+class RoleRegistry:
+    """Roles -> entry-point specs, plus the per-rule allowlists."""
+
+    roles: Dict[str, Tuple[str, ...]]
+    #: TPU602 roots — the decode hot loop (zero-sync invariant)
+    hot_roots: Tuple[str, ...] = ()
+    #: TPU602: functions allowed to sync, spec -> mandatory reason
+    fetch_allowlist: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: TPU603: ("pkg.module:Class", "field") -> mandatory reason
+    shared_fields: Dict[Tuple[str, str], str] = \
+        dataclasses.field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return not any(self.roles.values())
+
+
+_FRONTEND = "paddle_tpu.serving.frontend"
+_SCHED = "paddle_tpu.serving.scheduler"
+_DISAGG = "paddle_tpu.serving.disagg"
+_CKPT = "paddle_tpu.incubate.checkpoint"
+_LIVE = "paddle_tpu.observability.liveness"
+_AGG = "paddle_tpu.observability.aggregate"
+_STORE = "paddle_tpu.distributed.store"
+_ELASTIC = "paddle_tpu.distributed.fleet.elastic"
+
+DEFAULT_REGISTRY = RoleRegistry(
+    roles={
+        "scheduler": (
+            f"{_SCHED}:ContinuousBatchingScheduler.step",
+            f"{_SCHED}:ContinuousBatchingScheduler.decode_once",
+            f"{_SCHED}:ContinuousBatchingScheduler.run",
+            f"{_SCHED}:ContinuousBatchingScheduler.submit",
+            f"{_SCHED}:ContinuousBatchingScheduler.cancel",
+            f"{_SCHED}:ContinuousBatchingScheduler.has_work",
+            f"{_SCHED}:ContinuousBatchingScheduler.prefill_once",
+            f"{_SCHED}:ContinuousBatchingScheduler.admit",
+            f"{_DISAGG}:DisaggScheduler.admit",
+            f"{_DISAGG}:DisaggScheduler.prefill_once",
+            f"{_DISAGG}:DisaggScheduler.cancel",
+            f"{_DISAGG}:DisaggScheduler.has_work",
+            f"{_FRONTEND}:ServingFrontend._sched_main",
+            f"{_FRONTEND}:ServingFrontend._on_token",
+            f"{_FRONTEND}:ServingFrontend._on_finish",
+            f"{_FRONTEND}:_Stream.push",
+        ),
+        "event_loop": (
+            f"{_FRONTEND}:ServingFrontend._loop_main",
+            f"{_FRONTEND}:ServingFrontend._handle",
+            f"{_FRONTEND}:ServingFrontend._generate",
+            f"{_FRONTEND}:ServingFrontend._stream_response",
+            f"{_FRONTEND}:ServingFrontend._buffered_response",
+            f"{_FRONTEND}:ServingFrontend._heartbeat",
+            f"{_FRONTEND}:ServingFrontend._respond_json",
+            f"{_FRONTEND}:ServingFrontend._read_request",
+            f"{_FRONTEND}:ServingFrontend._cancel_stream",
+        ),
+        "writer": (
+            f"{_CKPT}:CheckpointManager._drain",
+            f"{_CKPT}:CheckpointManager._drain_remaining",
+            f"{_AGG}:HostPublisher._run",
+            f"{_STORE}:_PyStoreServer._accept",
+            f"{_STORE}:_PyStoreServer._serve",
+        ),
+        "monitor": (
+            f"{_LIVE}:LivenessMonitor._run",
+            f"{_ELASTIC}:ElasticManager._hb_loop",
+        ),
+        "main": (
+            f"{_FRONTEND}:ServingFrontend.start",
+            f"{_FRONTEND}:ServingFrontend.stop",
+            f"{_FRONTEND}:ServingFrontend.drain",
+            f"{_FRONTEND}:ServingFrontend.wait_drained",
+            f"{_CKPT}:CheckpointManager.save",
+            f"{_CKPT}:CheckpointManager.wait",
+            f"{_CKPT}:CheckpointManager.close",
+            f"{_CKPT}:CheckpointManager.restore",
+            f"{_AGG}:HostPublisher.start",
+            f"{_AGG}:HostPublisher.stop",
+            f"{_AGG}:HostPublisher.publish_once",
+            f"{_LIVE}:LivenessMonitor.start",
+            f"{_LIVE}:LivenessMonitor.stop",
+            f"{_LIVE}:LivenessMonitor.check_now",
+            f"{_LIVE}:enable",
+            f"{_LIVE}:disable",
+            f"{_ELASTIC}:ElasticManager.start",
+            f"{_ELASTIC}:ElasticManager.stop",
+            f"{_ELASTIC}:ElasticManager.watch",
+            f"{_ELASTIC}:ElasticManager.wait_for_np",
+        ),
+    },
+    hot_roots=(
+        f"{_SCHED}:ContinuousBatchingScheduler.step",
+        f"{_SCHED}:ContinuousBatchingScheduler.decode_once",
+        f"{_SCHED}:ContinuousBatchingScheduler.run",
+    ),
+    fetch_allowlist={
+        f"{_SCHED}:ContinuousBatchingScheduler._consume_inflight":
+            "the one allowlisted blocking fetch of an iteration "
+            "(decode_fetch/decode_spec_fetch) plus the int() casts on "
+            "the already-fetched host arrays",
+        f"{_DISAGG}:DisaggScheduler._after_final_chunk":
+            "ready-guarded first-token fetch: int(dev) runs only after "
+            "dev.is_ready() returned True, so the cast never blocks the "
+            "loop",
+    },
+    shared_fields={
+        (f"{_CKPT}:CheckpointManager", "_err"):
+            "single-slot async-error handoff: the writer publishes the "
+            "exception, save()/wait() consume-and-clear; both sides are "
+            "single GIL-atomic reference swaps and a torn interleaving "
+            "only defers the re-raise to the next save()",
+    },
+)

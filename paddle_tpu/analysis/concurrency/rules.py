@@ -1,0 +1,408 @@
+"""TPU601–TPU604 — the concurrency rule passes.
+
+Each pass consumes a :class:`ConcurrencyContext` — the package-wide
+:class:`~paddle_tpu.analysis.concurrency.graph.CallGraph` plus the
+role closures computed from the registry — and yields plain
+:class:`~paddle_tpu.analysis.core.Finding` objects so the baseline,
+inline-suppression and ``--format`` machinery of the AST tier apply
+unchanged.
+
+Shared vocabulary: the device-sync markers (``SYNC_METHODS`` /
+``SYNC_CALLS`` / ``SYNC_BUILTINS``) are imported from the TPU101 pass —
+one definition of "what is a sync" across tiers — with one narrowing:
+TPU602 only flags ``int(x)``/``float(x)``/``bool(x)`` on a bare *Name*
+(the PR-14 bug was ``int(tok)`` on a device array; ``int(task.ids.size)``
+on host metadata is fine and common in the scheduler).
+
+Known, deliberate lexical limits (documented in ANALYSIS.md):
+
+* a ``with self._lock:`` *statement* is never itself a blocking finding
+  (idiomatic bounded critical section); only explicit un-timeouted
+  ``.acquire()`` calls are;
+* lock scope is lexical — a helper *called* under a lock is scanned as
+  unlocked (and a nested def defined under a lock runs later, so it
+  correctly does NOT inherit the lock);
+* anything inside an ``await`` expression is exempt from TPU601 — the
+  event loop yields there (``await q.get()``,
+  ``await asyncio.wait_for(q.get(), t)``, ``run_in_executor``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import FileContext, Finding, ScopedVisitor
+from ..host_sync import SYNC_BUILTINS, SYNC_CALLS, SYNC_METHODS
+from .graph import CallGraph
+from .roles import RoleRegistry
+
+__all__ = ["ConcurrencyContext", "ConcurrencyPass", "LoopBlockingPass",
+           "DecodeSyncPass", "SharedStatePass", "ThreadHygienePass"]
+
+#: zero-arg, no-timeout method calls that can park a thread forever
+BLOCKING_METHODS = {"get", "wait", "join", "result", "acquire"}
+#: distributed-store RPCs (blocking network I/O); matched only when the
+#: receiver's resolved name ends in a segment containing "store"
+STORE_OPS = {"get", "set", "add", "wait", "compare_set", "barrier"}
+
+
+@dataclasses.dataclass
+class ConcurrencyContext:
+    """Everything a concurrency pass needs, computed once per run."""
+
+    graph: CallGraph
+    registry: RoleRegistry
+    role_roots: Dict[str, Set[str]]     # role -> resolved root keys
+    role_reach: Dict[str, Set[str]]     # role -> reachable closure
+    hot_reach: Set[str]                 # TPU602 closure
+    fetch_keys: Set[str]                # resolved fetch_allowlist
+    scans: Dict[str, "_BodyScan"] = dataclasses.field(default_factory=dict)
+
+
+class ConcurrencyPass:
+    """Base class: one rule over the role closures."""
+
+    rule = "TPU600"
+    name = "base"
+    description = ""
+
+    def check(self, cc: ConcurrencyContext) -> Iterable[Finding]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# shared body scanner
+# ---------------------------------------------------------------------------
+
+def _is_lock_item(item: ast.withitem) -> bool:
+    """``with <expr>:`` — is <expr>'s final identifier lock-ish?
+    Covers ``self._lock``, ``_LOCK``, ``self._publish_lock``."""
+    e = item.context_expr
+    if isinstance(e, ast.Attribute):
+        name = e.attr
+    elif isinstance(e, ast.Name):
+        name = e.id
+    else:
+        return False
+    return "lock" in name.lower()
+
+
+def _self_fields(target) -> List[Tuple[str, ast.AST]]:
+    """Fields of ``self`` written by an assignment target: plain
+    ``self.x = ...`` and container stores ``self.x[k] = ...``; tuple
+    unpacking recursed."""
+    out: List[Tuple[str, ast.AST]] = []
+    if isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        out.append((target.attr, target))
+    elif isinstance(target, ast.Subscript):
+        v = target.value
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self":
+            out.append((v.attr, target))
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for t in target.elts:
+            out.extend(_self_fields(t))
+    elif isinstance(target, ast.Starred):
+        out.extend(_self_fields(target.value))
+    return out
+
+
+class _BodyScan(ast.NodeVisitor):
+    """One walk of a function body collecting calls (with await/lock
+    context), self-field writes (with lock context) and with-lock
+    statements (with enclosing lock depth).  Nested defs/lambdas are
+    skipped — they are their own graph nodes, judged by their own
+    reachability, and do not run under an enclosing lexical lock."""
+
+    def __init__(self):
+        self.calls: List[Tuple[ast.Call, bool, int]] = []
+        self.writes: List[Tuple[str, ast.AST, bool]] = []
+        self.lock_withs: List[Tuple[ast.AST, int]] = []
+        self._await = 0
+        self._locks = 0
+
+    def scan(self, fn_node):
+        for stmt in fn_node.body:
+            self.visit(stmt)
+        return self
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Await(self, node):
+        self._await += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._await -= 1
+
+    def visit_Call(self, node):
+        self.calls.append((node, self._await > 0, self._locks))
+        self.generic_visit(node)
+
+    def _with(self, node):
+        is_lock = any(_is_lock_item(i) for i in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars:
+                self.visit(item.optional_vars)
+        if is_lock:
+            self.lock_withs.append((node, self._locks))
+            self._locks += 1
+        try:
+            for stmt in node.body:
+                self.visit(stmt)
+        finally:
+            if is_lock:
+                self._locks -= 1
+
+    visit_With = _with
+    visit_AsyncWith = _with
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for field, tn in _self_fields(t):
+                self.writes.append((field, tn, self._locks > 0))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        for field, tn in _self_fields(node.target):
+            self.writes.append((field, tn, self._locks > 0))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            for field, tn in _self_fields(node.target):
+                self.writes.append((field, tn, self._locks > 0))
+        self.generic_visit(node)
+
+
+def _blocking_reason(ctx: FileContext, node: ast.Call):
+    """Why this call can park the calling thread, or ``None``."""
+    f = node.func
+    q = ctx.resolve(f)
+    if q == "time.sleep":
+        return "time.sleep() parks the thread"
+    if q == "open":
+        return "file I/O (open) blocks the thread"
+    if q in ("jax.block_until_ready", "jax.device_get"):
+        return f"{q} blocks on the device"
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready" and not node.args:
+            return ".block_until_ready() blocks on the device"
+        base = ctx.resolve(f.value)
+        if base and f.attr in STORE_OPS \
+                and "store" in base.rsplit(".", 1)[-1].lower():
+            return f"store op .{f.attr}() does blocking network I/O"
+        if f.attr in BLOCKING_METHODS and not node.args \
+                and not any(kw.arg in ("timeout", "block")
+                            for kw in node.keywords if kw.arg):
+            return f".{f.attr}() with no timeout can block forever"
+    return None
+
+
+def _scan(cc: ConcurrencyContext, key: str) -> _BodyScan:
+    """Per-run memoized body scan (several passes visit the same fn)."""
+    if key not in cc.scans:
+        cc.scans[key] = _BodyScan().scan(cc.graph.fns[key].node)
+    return cc.scans[key]
+
+
+# ---------------------------------------------------------------------------
+# TPU601 — blocking call reachable from the event-loop thread
+# ---------------------------------------------------------------------------
+
+class LoopBlockingPass(ConcurrencyPass):
+    rule = "TPU601"
+    name = "loop-blocking"
+    description = ("blocking call (sleep / file or store I/O / "
+                   "un-timeouted get/wait/acquire) reachable from the "
+                   "event-loop thread")
+
+    def check(self, cc: ConcurrencyContext):
+        for key in sorted(cc.role_reach.get("event_loop", ())):
+            info = cc.graph.fns[key]
+            for call, awaited, _locks in _scan(cc, key).calls:
+                if awaited:
+                    continue
+                reason = _blocking_reason(info.ctx, call)
+                if reason:
+                    yield info.ctx.finding(
+                        self.rule, call,
+                        f"{reason} — reachable on the event-loop thread "
+                        f"(role 'event_loop'); every open stream stalls "
+                        f"behind it",
+                        symbol=info.qualname)
+
+
+# ---------------------------------------------------------------------------
+# TPU602 — device sync in the decode hot loop
+# ---------------------------------------------------------------------------
+
+class DecodeSyncPass(ConcurrencyPass):
+    rule = "TPU602"
+    name = "decode-sync"
+    description = ("device→host sync reachable from the decode hot loop "
+                   "outside the allowlisted fetch points (zero-syncs-per-"
+                   "iteration invariant)")
+
+    def check(self, cc: ConcurrencyContext):
+        for key in sorted(cc.hot_reach - cc.fetch_keys):
+            info = cc.graph.fns[key]
+            for call, _awaited, _locks in _scan(cc, key).calls:
+                f = call.func
+                msg = None
+                if isinstance(f, ast.Attribute) and f.attr in SYNC_METHODS \
+                        and not call.args:
+                    msg = f".{f.attr}() forces a device→host sync"
+                else:
+                    q = info.ctx.resolve(f)
+                    if q in SYNC_CALLS:
+                        msg = f"{q} materializes a device value on host"
+                    elif isinstance(f, ast.Name) and f.id in SYNC_BUILTINS \
+                            and q == f.id and len(call.args) == 1 \
+                            and not call.keywords \
+                            and isinstance(call.args[0], ast.Name):
+                        msg = (f"{f.id}(...) on a variable concretizes it "
+                               f"(host sync)")
+                if msg:
+                    yield info.ctx.finding(
+                        self.rule, call,
+                        f"{msg} — in the decode hot loop outside the "
+                        f"fetch allowlist; the loop's contract is zero "
+                        f"device syncs per iteration",
+                        symbol=info.qualname)
+
+
+# ---------------------------------------------------------------------------
+# TPU603 — cross-thread shared state without a common lock
+# ---------------------------------------------------------------------------
+
+class SharedStatePass(ConcurrencyPass):
+    rule = "TPU603"
+    name = "shared-state"
+    description = ("attribute written from ≥2 thread roles with at least "
+                   "one write outside a lock and no shared_fields entry")
+
+    def check(self, cc: ConcurrencyContext):
+        # (class spec, field) -> role -> [(info, node, locked)]
+        table: Dict[Tuple[str, str], Dict[str, list]] = {}
+        for role, keys in cc.role_reach.items():
+            for key in keys:
+                info = cc.graph.fns[key]
+                if info.cls is None \
+                        or info.node.name in ("__init__", "__new__"):
+                    # __init__ writes happen-before any thread starts
+                    continue
+                spec = f"{info.module}:{info.cls}"
+                for field, node, locked in _scan(cc, key).writes:
+                    table.setdefault((spec, field), {}) \
+                        .setdefault(role, []).append((info, node, locked))
+        for (spec, field), by_role in sorted(table.items()):
+            if len(by_role) < 2:
+                continue
+            if (spec, field) in cc.registry.shared_fields:
+                continue
+            roles = "/".join(sorted(by_role))
+            seen: Set[Tuple[str, int, int]] = set()
+            for sites in by_role.values():
+                for info, node, locked in sites:
+                    if locked:
+                        continue
+                    at = (info.key, node.lineno, node.col_offset)
+                    if at in seen:      # one fn can serve two roles
+                        continue
+                    seen.add(at)
+                    yield info.ctx.finding(
+                        self.rule, node,
+                        f"'{field}' of {spec} is written from roles "
+                        f"{roles} and this write holds no lock — guard "
+                        f"it or declare (class, field) in the registry's "
+                        f"shared_fields with a reason",
+                        symbol=info.qualname)
+
+
+# ---------------------------------------------------------------------------
+# TPU604 — blocking while locked / thread hygiene
+# ---------------------------------------------------------------------------
+
+class _ThreadCtorWalk(ScopedVisitor):
+    """Syntactic: every ``threading.Thread(...)`` construction site."""
+
+    def __init__(self, ctx: FileContext):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node):
+        if self.ctx.resolve(node.func) == "threading.Thread":
+            kws = {kw.arg for kw in node.keywords if kw.arg}
+            missing = [k for k in ("daemon", "name") if k not in kws]
+            if missing:
+                self.findings.append(self.ctx.finding(
+                    "TPU604", node,
+                    f"threading.Thread(...) without "
+                    f"{' and '.join(k + '=' for k in missing)} in the "
+                    f"constructor — unnamed threads break watchdog "
+                    f"postmortem attribution, non-daemon threads hang "
+                    f"interpreter shutdown",
+                    symbol=self.symbol))
+            if self.symbol == "<module>":
+                self.findings.append(self.ctx.finding(
+                    "TPU604", node,
+                    "thread constructed at import time — it can start "
+                    "before the chained threading.excepthook "
+                    "(observability.flight) is installed, losing crash "
+                    "postmortems",
+                    symbol=self.symbol))
+        self.generic_visit(node)
+
+
+class ThreadHygienePass(ConcurrencyPass):
+    rule = "TPU604"
+    name = "thread-hygiene"
+    description = ("blocking op or second lock acquired while holding a "
+                   "lock; Thread(...) without daemon=/name= or built at "
+                   "import time")
+
+    def check(self, cc: ConcurrencyContext):
+        for ctx in cc.graph.contexts:
+            walk = _ThreadCtorWalk(ctx)
+            walk.visit(ctx.tree)
+            yield from walk.findings
+        for key in sorted(cc.graph.fns):
+            info = cc.graph.fns[key]
+            scan = _scan(cc, key)
+            for node, depth in scan.lock_withs:
+                if depth >= 1:
+                    yield info.ctx.finding(
+                        self.rule, node,
+                        "second lock acquired while holding one — "
+                        "lock-order inversion risk; restructure or keep "
+                        "a single-lock discipline",
+                        symbol=info.qualname)
+            for call, awaited, locks in scan.calls:
+                if locks < 1 or awaited:
+                    continue
+                reason = _blocking_reason(info.ctx, call)
+                if reason and not (isinstance(call.func, ast.Attribute)
+                                   and call.func.attr == "acquire"):
+                    yield info.ctx.finding(
+                        self.rule, call,
+                        f"{reason} while holding a lock — every thread "
+                        f"contending on that lock stalls with it",
+                        symbol=info.qualname)
+                elif reason:
+                    yield info.ctx.finding(
+                        self.rule, call,
+                        "explicit .acquire() of a second lock while "
+                        "holding one — lock-order inversion risk",
+                        symbol=info.qualname)

@@ -271,10 +271,11 @@ class Analyzer:
                 baseline_path = None
         base = Baseline.load(baseline_path) if baseline_path \
             else Baseline([])
-        # TPU5xx entries belong to the trace tier (analysis.trace) —
+        # TPU5xx entries belong to the trace tier (analysis.trace) and
+        # TPU6xx to the concurrency tier (analysis.concurrency) —
         # excluded here so they are never reported stale by an AST run
         self.baseline = base.subset(
-            lambda e: not e.rule.startswith("TPU5"))
+            lambda e: not e.rule.startswith(("TPU5", "TPU6")))
 
     def run(self, paths: Sequence[str]) -> Report:
         report = Report([], [], [], [], [])
@@ -302,15 +303,23 @@ class Analyzer:
                 for ctx in contexts:
                     raw.extend(pz.check(ctx))
         raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-
-        by_line: Dict[str, FileContext] = {c.relpath: c for c in contexts}
-        for f in raw:
-            ctx = by_line.get(f.path)
-            if ctx is not None and f.rule in ctx.disabled_rules(f.line):
-                report.inline_suppressed.append(f)
-            elif self.baseline.matches(f):
-                report.baselined.append(f)
-            else:
-                report.findings.append(f)
-        report.stale_baseline = self.baseline.stale()
+        fold_findings(report, raw, contexts, self.baseline)
         return report
+
+
+def fold_findings(report: Report, raw: Sequence[Finding],
+                  contexts: Sequence[FileContext], baseline) -> Report:
+    """Classify raw findings into live / inline-suppressed / baselined
+    and surface stale baseline entries.  Shared by every tier so the
+    suppression semantics cannot drift between them."""
+    by_path: Dict[str, FileContext] = {c.relpath: c for c in contexts}
+    for f in raw:
+        ctx = by_path.get(f.path)
+        if ctx is not None and f.rule in ctx.disabled_rules(f.line):
+            report.inline_suppressed.append(f)
+        elif baseline.matches(f):
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    report.stale_baseline = baseline.stale()
+    return report

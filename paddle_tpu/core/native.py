@@ -45,6 +45,9 @@ def load():
         lib.tcp_store_server_destroy.argtypes = [ctypes.c_void_p]
         lib.tcp_store_client_create.restype = ctypes.c_void_p
         lib.tcp_store_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tcp_store_client_create_t.restype = ctypes.c_void_p
+        lib.tcp_store_client_create_t.argtypes = [ctypes.c_char_p,
+                                                  ctypes.c_int, ctypes.c_int]
         lib.tcp_store_client_destroy.argtypes = [ctypes.c_void_p]
         lib.tcp_store_set.restype = ctypes.c_int
         lib.tcp_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,

@@ -69,6 +69,11 @@ class ElasticManager:
         self.node_timeout = node_timeout
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._beat_n = 0
+        # _beat runs on the caller's thread (start) and the heartbeat
+        # thread; the counter bump must be atomic — the store.set stays
+        # OUTSIDE the lock (blocking network I/O under a lock is TPU604)
+        self._beat_lock = threading.Lock()
         self._last_alive: Optional[frozenset] = None
         # liveness is judged by heartbeat-value CHANGE against the watcher's
         # own clock — never by comparing remote wall clocks (cross-node skew
@@ -87,16 +92,18 @@ class ElasticManager:
         register + TTL refresh, minus etcd)."""
         self.store.set(self._k("nodes", self.node_rank), b"1")
         self._beat()
-        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True,
+                                           name="elastic-heartbeat")
         self._hb_thread.start()
         return self
 
     def _beat(self):
         # monotonically changing value; watchers detect liveness by change,
         # not by decoding it (clock-skew independent)
-        self._beat_n = getattr(self, "_beat_n", 0) + 1
-        self.store.set(self._k("hb", self.node_rank),
-                       str(self._beat_n).encode())
+        with self._beat_lock:
+            self._beat_n += 1
+            n = self._beat_n
+        self.store.set(self._k("hb", self.node_rank), str(n).encode())
 
     def _hb_loop(self):
         while not self._stop.wait(self.heartbeat_interval):

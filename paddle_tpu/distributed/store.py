@@ -71,7 +71,11 @@ class TCPStore:
                 port = self._py_server.port
         self.port = port
         if lib is not None:
-            self._client = lib.tcp_store_client_create(host.encode(), port)
+            # the native client honors the caller's connect deadline the
+            # same way the pure-Python fallback does — a cluster CLI
+            # probing a dead master with --timeout 0.5 must not hang 30s
+            self._client = lib.tcp_store_client_create_t(
+                host.encode(), port, int(max(timeout, 0.0) * 1000))
             if not self._client:
                 raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
         else:
@@ -252,7 +256,8 @@ class _PyStoreServer:
         self._sock.bind(("0.0.0.0", port))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
-        threading.Thread(target=self._accept, daemon=True).start()
+        threading.Thread(target=self._accept, daemon=True,
+                         name="store-accept").start()
 
     def _accept(self):
         while True:
@@ -261,7 +266,7 @@ class _PyStoreServer:
             except OSError:
                 return
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="store-serve").start()
 
     def _serve(self, conn):
         def read_full(n):

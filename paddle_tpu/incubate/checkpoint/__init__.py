@@ -255,7 +255,8 @@ class CheckpointManager:
         self._worker: Optional[threading.Thread] = None
         self._closed = False
         if async_save:
-            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker = threading.Thread(target=self._drain, daemon=True,
+                                            name="checkpoint-writer")
             self._worker.start()
         # fetched once; the NOOP_BEACON singleton when liveness is off
         self._beacon = _liveness.beacon("checkpoint.writer")
@@ -454,7 +455,7 @@ class CheckpointManager:
         # a bounded join — _write on a wedged filesystem can block
         # indefinitely, and close() (atexit!) must not
         drainer = threading.Thread(target=self._drain_remaining,
-                                   daemon=True)
+                                   daemon=True, name="checkpoint-drain")
         drainer.start()
         drainer.join(timeout=max(0.1, deadline - time.monotonic()))
         if drainer.is_alive() or (worker is not None and worker.is_alive()):

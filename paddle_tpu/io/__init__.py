@@ -345,7 +345,8 @@ class DataLoader:
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="dataloader-producer")
         t.start()
         while True:
             item = q.get()

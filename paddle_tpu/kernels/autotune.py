@@ -232,18 +232,23 @@ def _load_cache() -> dict:
     with _LOCK:
         if _CACHE is not None and _CACHE_LOADED_FROM == path:
             return _CACHE
-        data = {"version": _CACHE_VERSION, "families": {}}
-        if path and os.path.isfile(path):
-            try:
-                with open(path) as f:
-                    loaded = json.load(f)
-                if isinstance(loaded, dict) and \
-                        loaded.get("version") == _CACHE_VERSION:
-                    data = loaded
-            except (OSError, ValueError):
-                pass  # unreadable/corrupt cache = empty cache
-        _CACHE = data
-        _CACHE_LOADED_FROM = path
+    # file I/O outside the lock (blocking while locked stalls every
+    # autotune lookup behind a slow disk): racing first loads both read
+    # the file; the loser re-checks below and adopts the winner's copy
+    data = {"version": _CACHE_VERSION, "families": {}}
+    if path and os.path.isfile(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and \
+                    loaded.get("version") == _CACHE_VERSION:
+                data = loaded
+        except (OSError, ValueError):
+            pass  # unreadable/corrupt cache = empty cache
+    with _LOCK:
+        if _CACHE is None or _CACHE_LOADED_FROM != path:
+            _CACHE = data
+            _CACHE_LOADED_FROM = path
         return _CACHE
 
 

@@ -140,6 +140,9 @@ class HostPublisher:
         self.published = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards `published`: publish_once runs on the loop thread AND
+        # on whatever thread calls it directly (tests, stop(final=True))
+        self._publish_lock = threading.Lock()
 
     @property
     def key(self) -> str:
@@ -148,7 +151,8 @@ class HostPublisher:
     def publish_once(self) -> str:
         doc = host_snapshot(self.host)
         self.store.set(self.key, json.dumps(doc, sort_keys=True).encode())
-        self.published += 1
+        with self._publish_lock:
+            self.published += 1
         return self.key
 
     def start(self) -> "HostPublisher":
@@ -165,6 +169,16 @@ class HostPublisher:
         t = self._thread
         if t is not None:
             t.join(timeout)
+            if t.is_alive():
+                # the loop is wedged inside a store op: publishing the
+                # final snapshot NOW would race it on the same key, and
+                # waiting for it would block shutdown indefinitely —
+                # skip the final publish, keep stop() bounded
+                sys.stderr.write("[telemetry] publisher still busy after "
+                                 "%.1fs; skipping final publish\n"
+                                 % timeout)
+                self._thread = None
+                return
         self._thread = None
         if final:
             try:

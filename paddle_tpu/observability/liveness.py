@@ -391,9 +391,13 @@ class LivenessMonitor:
             age = max(now_ns - stamp, 0) * 1e-9
             if age <= deadline:
                 continue
-            if self._fired_stamp.get(b.name) == stamp:
-                continue                   # already reported this hang
-            self._fired_stamp[b.name] = stamp
+            with self._lock:
+                # check_now runs on the monitor thread AND directly on
+                # callers' threads (tests, manual probes): the fired-
+                # stamp dedup must be atomic or one hang reports twice
+                if self._fired_stamp.get(b.name) == stamp:
+                    continue               # already reported this hang
+                self._fired_stamp[b.name] = stamp
             fired.append(self._fire_stall(b, age, deadline))
         return fired
 

@@ -112,8 +112,8 @@ def buffered(reader, size):
     def data_reader():
         r = reader()
         q = _queue.Queue(maxsize=size)
-        t = threading.Thread(target=read_worker, args=(r, q))
-        t.daemon = True
+        t = threading.Thread(target=read_worker, args=(r, q),
+                             daemon=True, name="reader-buffered")
         t.start()
         e = q.get()
         while not isinstance(e, _End):
@@ -160,13 +160,13 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def xreader():
         in_q = _queue.Queue(buffer_size)
         out_q = _queue.Queue(buffer_size)
-        t = threading.Thread(target=read_worker, args=(reader, in_q))
-        t.daemon = True
+        t = threading.Thread(target=read_worker, args=(reader, in_q),
+                             daemon=True, name="reader-xmap-read")
         t.start()
         workers = []
         for _ in range(process_num):
-            w = threading.Thread(target=map_worker, args=(in_q, out_q))
-            w.daemon = True
+            w = threading.Thread(target=map_worker, args=(in_q, out_q),
+                                 daemon=True, name="reader-xmap-map")
             w.start()
             workers.append(w)
         finished = 0
@@ -212,8 +212,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def reader():
         q = _queue.Queue(queue_size)
         for r in readers:
-            t = threading.Thread(target=worker, args=(r, q))
-            t.daemon = True
+            t = threading.Thread(target=worker, args=(r, q),
+                                 daemon=True, name="reader-multiprocess")
             t.start()
         finished = 0
         while finished < len(readers):

@@ -200,8 +200,11 @@ class ServingFrontend:
         """Graceful shutdown: drain outstanding work (503 for new
         requests), stop the scheduler thread, close the server.
         Re-raises any error the scheduler thread died on."""
-        self._draining = True
-        self._stop = True
+        # under the lock: the scheduler thread also writes _draining (on
+        # a guard fire) — unlocked cross-thread writes are TPU603
+        with self._lock:
+            self._draining = True
+            self._stop = True
         self._wake.set()
         if self._sched_thread is not None:
             self._sched_thread.join(timeout)
@@ -216,7 +219,8 @@ class ServingFrontend:
         """Enter drain mode programmatically (what a guard fire does):
         new requests 503, everything already accepted runs to
         completion."""
-        self._draining = True
+        with self._lock:
+            self._draining = True
         self._wake.set()
 
     @property
@@ -243,7 +247,8 @@ class ServingFrontend:
                     # admitting, finish what we hold — never drop.  The
                     # scheduler's recompute preemption keeps requeueing
                     # page-pressure victims during the drain.
-                    self._draining = True
+                    with self._lock:
+                        self._draining = True
                 with self._lock:
                     pending, self._pending = self._pending, []
                     cancels, self._cancels = self._cancels, []

@@ -1,0 +1,22 @@
+"""Clean fixture: correctly locked shared state, named daemon thread,
+awaited queue get — zero TPU6xx findings."""
+import threading
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def worker(self):
+        with self._lock:
+            self.n += 1
+
+    def main(self):
+        with self._lock:
+            self.n = 0
+        return threading.Thread(target=self.worker, daemon=True,
+                                name="clean-worker")
+
+    async def pump(self, q):
+        return await q.get()

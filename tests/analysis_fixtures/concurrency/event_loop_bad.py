@@ -1,0 +1,27 @@
+"""TPU601 fixture: blocking calls reachable from the event-loop role.
+
+The test registry pins ``Loop.handle`` and ``AsyncLoop.pump`` to the
+event_loop role; the sleep and the bare ``.get()`` in the helper are
+the positives, the timeouted get and the awaited get are negatives.
+"""
+import queue
+import time
+
+
+class Loop:
+    def __init__(self):
+        self.q = queue.Queue()
+
+    async def handle(self):
+        self._helper()
+        item = self.q.get(timeout=1.0)      # negative: bounded wait
+        return item
+
+    def _helper(self):
+        time.sleep(0.05)                    # positive: TPU601
+        return self.q.get()                 # positive: TPU601
+
+
+class AsyncLoop:
+    async def pump(self, aq):
+        return await aq.get()               # negative: the loop yields

@@ -1,0 +1,19 @@
+"""TPU602 fixture: device syncs in the decode hot loop.
+
+``Sched.step`` is the test registry's hot root; ``fetch`` is in its
+fetch_allowlist, so only the two syncs in ``_consume`` fire.
+"""
+
+
+class Sched:
+    def step(self, arr, x):
+        tok = self._consume(arr)
+        n = self.fetch(arr)
+        return tok + n + int(x.size)        # negative: attribute arg
+
+    def _consume(self, arr):
+        tok = arr.item()                    # positive: TPU602
+        return int(tok)                     # positive: TPU602
+
+    def fetch(self, arr):
+        return arr.item()                   # negative: fetch allowlist

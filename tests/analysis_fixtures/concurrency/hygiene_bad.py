@@ -1,0 +1,33 @@
+"""TPU604 fixture: thread-hygiene violations.  Entirely syntactic —
+this rule needs no role registry.
+"""
+import threading
+import time
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+_BOOT = threading.Thread(target=print, daemon=True, name="boot")  # positive
+
+
+def make():
+    return threading.Thread(target=print)   # positive: no daemon=/name=
+
+
+def sleepy_locked():
+    with _LOCK_A:
+        time.sleep(0.01)                    # positive: blocking locked
+
+
+def nested_locks():
+    with _LOCK_A:
+        with _LOCK_B:                       # positive: second lock
+            return 1
+
+
+def suppressed():
+    return threading.Thread(target=print)  # tpu-lint: disable=TPU604
+
+
+def clean_thread():
+    return threading.Thread(target=print, daemon=True, name="ok")

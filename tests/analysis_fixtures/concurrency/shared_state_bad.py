@@ -1,0 +1,29 @@
+"""TPU603 fixture: a field written from two roles with unlocked
+writes; the allowlisted field and the locked-everywhere field stay
+clean.  The registry pins ``worker`` to writer, ``start``/``stop`` to
+main.
+"""
+import threading
+
+
+class Obj:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0                      # negative: __init__ excluded
+        self.ok_field = 0
+        self.safe = 0
+
+    def worker(self):
+        self.count += 1                     # positive: TPU603
+        self.ok_field += 1                  # negative: shared_fields
+        with self._lock:
+            self.safe += 1                  # negative: locked
+
+    def start(self):
+        self.count = 5                      # positive: TPU603
+        self.ok_field = 0                   # negative: shared_fields
+
+    def stop(self):
+        with self._lock:
+            self.count = 0                  # negative: locked write
+            self.safe = 0

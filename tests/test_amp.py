@@ -8,6 +8,7 @@ stalls.  The fp32 master copy must accumulate them.
 """
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -75,6 +76,7 @@ def test_trainstep_o2_master_weights():
     assert m.weight.dtype == paddle.bfloat16
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_trainstep_layer_stacking_parity():
     """The internal stacked-params optimization (TrainStep stack_layers)
     must be invisible: identical losses to the unstacked step, per-layer

@@ -1,0 +1,263 @@
+"""tpu-race (paddle_tpu.analysis.concurrency) — tier-1 gate.
+
+Same two jobs as test_static_analysis.py, one tier up: (1) pin each
+TPU6xx pass's detection on seeded fixture violations (exact rule id +
+file:line) under a fixture role registry, (2) run the whole paddle_tpu/
+tree strict so any new concurrency violation fails CI.  Plus the
+tier-specific contracts: empty/drifted registries are errors (never a
+silent green), the baseline is scoped per-tier in both directions, and
+the races fixed in this tier's introduction stay fixed.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.analysis import (CONCURRENCY_PASSES, CONCURRENCY_RULES,
+                                 RULES, TRACE_RULES, Analyzer,
+                                 ConcurrencyAnalyzer, RoleRegistry)
+from paddle_tpu.analysis.concurrency import CallGraph
+from paddle_tpu.analysis.core import FileContext
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "analysis_fixtures", "concurrency")
+FIXMOD = "tests.analysis_fixtures.concurrency"
+
+#: fixture thread model: who runs what in tests/analysis_fixtures/concurrency
+REGISTRY = RoleRegistry(
+    roles={
+        "event_loop": (f"{FIXMOD}.event_loop_bad:Loop.handle",
+                       f"{FIXMOD}.event_loop_bad:AsyncLoop.pump",
+                       f"{FIXMOD}.clean:Clean.pump"),
+        "scheduler": (f"{FIXMOD}.hot_loop_bad:Sched.step",),
+        "writer": (f"{FIXMOD}.shared_state_bad:Obj.worker",
+                   f"{FIXMOD}.clean:Clean.worker"),
+        "main": (f"{FIXMOD}.shared_state_bad:Obj.start",
+                 f"{FIXMOD}.shared_state_bad:Obj.stop",
+                 f"{FIXMOD}.clean:Clean.main"),
+        "monitor": (),
+    },
+    hot_roots=(f"{FIXMOD}.hot_loop_bad:Sched.step",),
+    fetch_allowlist={
+        f"{FIXMOD}.hot_loop_bad:Sched.fetch": "fixture fetch point"},
+    shared_fields={
+        (f"{FIXMOD}.shared_state_bad:Obj", "ok_field"):
+            "fixture: declared cross-role field"},
+)
+
+
+def _fixture_report(baseline_path=None, registry=REGISTRY):
+    an = ConcurrencyAnalyzer(root=REPO, baseline_path=baseline_path,
+                             registry=registry)
+    return an.run([FIXDIR])
+
+
+@pytest.fixture(scope="module")
+def tree_report():
+    """One whole-tree strict run shared by the gate + regression tests
+    (a full call-graph build costs seconds — every whole-tree assertion
+    in this file reads this one report)."""
+    return ConcurrencyAnalyzer(root=REPO).run(None)
+
+
+def test_rule_catalogue():
+    assert set(CONCURRENCY_RULES) == {"TPU601", "TPU602", "TPU603",
+                                      "TPU604"}
+    assert len(CONCURRENCY_PASSES) == 4
+    # the tiers stay disjoint — the AST catalogue test pins its own set
+    assert not set(CONCURRENCY_RULES) & set(RULES)
+    assert not set(CONCURRENCY_RULES) & set(TRACE_RULES)
+
+
+def test_fixture_matrix():
+    """Each seeded fixture trips exactly its rule at the pinned lines;
+    clean.py trips nothing."""
+    report = _fixture_report()
+    assert not report.errors, report.errors
+    got = sorted((os.path.basename(f.path), f.rule, f.line)
+                 for f in report.findings)
+    assert got == [
+        ("event_loop_bad.py", "TPU601", 21),   # time.sleep in helper
+        ("event_loop_bad.py", "TPU601", 22),   # bare q.get()
+        ("hot_loop_bad.py", "TPU602", 15),     # .item() in hot loop
+        ("hot_loop_bad.py", "TPU602", 16),     # int(tok) on a Name
+        ("hygiene_bad.py", "TPU604", 10),      # thread built at import
+        ("hygiene_bad.py", "TPU604", 14),      # no daemon=/name=
+        ("hygiene_bad.py", "TPU604", 19),      # sleep while locked
+        ("hygiene_bad.py", "TPU604", 24),      # second lock held
+        ("shared_state_bad.py", "TPU603", 17),  # writer-role write
+        ("shared_state_bad.py", "TPU603", 23),  # main-role write
+    ], "\n".join(f.format() for f in report.findings)
+    # the cross-file role attribution lands in the symbol column
+    helper = [f for f in report.findings if f.line == 21][0]
+    assert helper.symbol == "Loop._helper"
+
+
+def test_inline_suppression():
+    report = _fixture_report()
+    sup = [f for f in report.inline_suppressed
+           if f.path.endswith("hygiene_bad.py")]
+    assert len(sup) == 1 and sup[0].rule == "TPU604" and sup[0].line == 29
+    assert not any(f.line == 29 for f in report.findings
+                   if f.path.endswith("hygiene_bad.py"))
+
+
+def test_baseline_suppression(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "TPU601 tests/analysis_fixtures/concurrency/event_loop_bad.py"
+        "::Loop._helper  # fixture: accepted for the baseline test\n"
+        "TPU699 tests/analysis_fixtures/concurrency/clean.py  # stale\n")
+    report = _fixture_report(baseline_path=str(bl))
+    assert not any(f.rule == "TPU601" for f in report.findings)
+    assert sum(f.rule == "TPU601" for f in report.baselined) == 2
+    assert len(report.stale_baseline) == 1
+    assert "TPU699" in report.stale_baseline[0]
+
+
+def test_per_tier_baseline_isolation(tmp_path):
+    """Neither tier loads (or stale-flags) the other's entries."""
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "TPU101 tests/analysis_fixtures/host_sync_bad.py::_log_scale"
+        "  # ast-tier entry\n"
+        "TPU601 tests/analysis_fixtures/concurrency/event_loop_bad.py"
+        "::Loop._helper  # concurrency-tier entry\n")
+    conc = _fixture_report(baseline_path=str(bl))
+    assert conc.baselined and all(f.rule == "TPU601"
+                                  for f in conc.baselined)
+    assert conc.stale_baseline == []        # TPU101 entry never loaded
+    ast_rep = Analyzer(root=REPO, baseline_path=str(bl)).run(
+        [os.path.join(REPO, "tests", "analysis_fixtures")])
+    assert any(f.rule == "TPU101" for f in ast_rep.baselined)
+    assert ast_rep.stale_baseline == []     # TPU601 entry never loaded
+
+
+def test_empty_registry_is_an_error():
+    empty = RoleRegistry(roles={r: () for r in
+                                ("scheduler", "event_loop", "writer",
+                                 "monitor", "main")})
+    report = _fixture_report(registry=empty)
+    assert not report.ok
+    assert any("registry is empty" in e for e in report.errors)
+
+
+def test_registry_drift_is_an_error():
+    drifted = RoleRegistry(roles={
+        "main": (f"{FIXMOD}.event_loop_bad:Loop.no_such_method",)})
+    report = _fixture_report(registry=drifted)
+    assert not report.ok
+    assert any("drift" in e for e in report.errors)
+
+
+def test_unscanned_modules_are_skipped_but_zero_roots_fail():
+    # entries for modules outside the scanned paths are silently skipped…
+    mixed = RoleRegistry(roles={
+        "main": ("paddle_tpu.serving.frontend:ServingFrontend.stop",
+                 f"{FIXMOD}.shared_state_bad:Obj.start")})
+    report = _fixture_report(registry=mixed)
+    assert not any("drift" in e for e in report.errors)
+    # …but when NO root resolves, the run refuses to report green
+    only_foreign = RoleRegistry(roles={
+        "main": ("paddle_tpu.serving.frontend:ServingFrontend.stop",)})
+    report = _fixture_report(registry=only_foreign)
+    assert not report.ok
+    assert any("no role roots" in e for e in report.errors)
+
+
+def test_callgraph_inheritance_and_virtual_dispatch():
+    """Roots on a subclass resolve through the MRO, and base-class
+    self-calls reach scanned subclass overrides."""
+    ctxs = [FileContext(os.path.join(REPO, p), REPO)
+            for p in ("paddle_tpu/serving/scheduler.py",
+                      "paddle_tpu/serving/disagg.py")]
+    g = CallGraph(ctxs)
+    key = g.resolve_root("paddle_tpu.serving.disagg:DisaggScheduler.step")
+    assert key == ("paddle_tpu.serving.scheduler:"
+                   "ContinuousBatchingScheduler.step")
+    reach = g.reachable([key])
+    assert "paddle_tpu.serving.disagg:DisaggScheduler.admit" in reach
+
+
+def test_whole_tree_strict_green(tree_report):
+    """THE gate: every TPU6xx finding in paddle_tpu/ is fixed or
+    carries a baselined reason, and the baseline holds no dead
+    weight."""
+    assert tree_report.ok, "new tpu-race findings:\n" + \
+        "\n".join(f.format() for f in tree_report.findings)
+    assert not tree_report.stale_baseline, \
+        "stale baseline entries:\n" + \
+        "\n".join(tree_report.stale_baseline)
+    assert tree_report.files > 100
+    assert tree_report.baselined, \
+        "baseline expected to cover the documented host-staging cases"
+
+
+def test_fixed_races_stay_fixed(tree_report):
+    """The TPU603 races fixed when this tier landed (frontend._draining
+    written by main+scheduler; HostPublisher.published by main+writer;
+    LivenessMonitor._fired_stamp; ElasticManager._beat_n) must stay
+    FIXED — not reappear and not get baselined away.  findings +
+    baselined together are exactly the unbaselined view, so the shared
+    tree run answers this without a second call-graph build."""
+    t603 = [f for f in tree_report.findings + tree_report.baselined
+            if f.rule == "TPU603"]
+    for path in ("paddle_tpu/serving/frontend.py",
+                 "paddle_tpu/observability/aggregate.py",
+                 "paddle_tpu/observability/liveness.py",
+                 "paddle_tpu/distributed/fleet/elastic/__init__.py"):
+        hits = [f for f in t603 if f.path == path]
+        assert hits == [], "\n".join(f.format() for f in hits)
+
+
+def test_missing_path_is_an_error():
+    report = ConcurrencyAnalyzer(root=REPO, baseline_path=None) \
+        .run(["no_such_dir_xyz"])
+    assert not report.ok and report.errors
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--concurrency", "no_such_dir_xyz", "--root", REPO,
+                 "--strict", "-q", "--baseline", "none"]) == 2
+
+
+def test_cli_error_exit_codes():
+    """The cheap rc-2 discipline cases (no whole-tree graph build)."""
+    from paddle_tpu.analysis.__main__ import main
+    # the CLI runs the DEFAULT registry: scoping it to the fixture dir
+    # resolves zero roots, which must be exit 2, never a silent green
+    assert main(["--concurrency", FIXDIR, "--root", REPO, "--strict",
+                 "-q", "--baseline", "none"]) == 2
+    # tier-scoped --select: rules of another tier are unknown here
+    assert main(["--concurrency", "--root", REPO, "--select", "TPU101",
+                 "-q"]) == 2
+    # the tiers are separate invocations
+    assert main(["--concurrency", "--trace", "-q"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_whole_tree_strict_green():
+    """The exact CI invocation exits 0 (slow: each call is a full
+    call-graph build; runs in the unfiltered CI step)."""
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--concurrency", "--root", REPO, "--strict", "-q"]) == 0
+    assert main(["--concurrency", "--root", REPO, "--strict", "-q",
+                 "--select", "TPU604"]) == 0
+
+
+def test_list_rules_covers_all_tiers(capsys):
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    lines = {ln.split()[0]: ln for ln in out.splitlines() if ln}
+    for rule, tier in (("TPU101", "ast"), ("TPU505", "trace"),
+                       ("TPU601", "concurrency"),
+                       ("TPU604", "concurrency")):
+        assert rule in lines and tier in lines[rule]
+
+
+@pytest.mark.slow
+def test_whole_tree_run_is_deterministic(tree_report):
+    """Two full call-graph runs produce byte-identical findings —
+    the graph build has no ordering dependence on dict/set iteration."""
+    again = ConcurrencyAnalyzer(root=REPO).run(None)
+    fmt = lambda r: [f.format() for f in r.findings + r.baselined]
+    assert fmt(again) == fmt(tree_report)
+    assert again.files == tree_report.files

@@ -210,6 +210,7 @@ def test_compile_program_caches_on_meta():
 # the `programs` CLI
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_programs_cli_pattern_subset(capsys):
     from paddle_tpu.observability.__main__ import main
     rc = main(["programs", "pallas/ln/*"])

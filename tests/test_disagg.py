@@ -179,6 +179,7 @@ def test_disagg_parity_via_host_staging(model, monkeypatch):
     assert sched.handoffs_total > 0
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_disagg_via_host_staging_bf16_pool(model, monkeypatch):
     """The host-staging spill must round-trip ml_dtypes pools
     byte-exactly: npz saves bfloat16 as void '|V2' and a naive reload
@@ -262,6 +263,7 @@ def test_disagg_preemption_under_decode_pool_pressure(model):
     assert pe._alloc.pages_used() == 0
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_disagg_handoff_limit_backpressure(model):
     """handoff_limit=1 bounds the ready queue: prefill-complete slots
     park (pages held) until the queue drains, and everything still

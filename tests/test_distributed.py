@@ -647,6 +647,7 @@ def test_ring_attention_gqa_matches_dense(mesh8):
                                atol=2e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_ring_attention_long_seq_blockwise_memory(mesh8):
     """The VERDICT-r3 Weak-#1 scenario: a sequence long enough that the
     OLD dense inner block (s_loc x s_loc f32 logits) would materialise

@@ -112,6 +112,7 @@ def test_pipelined_ignores_resident_budget():
                                    atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @pytest.mark.parametrize("variant", ["iotafree", "bf16chain+iotafree"])
 def test_variant_split_backward_parity(variant):
     """Variant kernels on the SPLIT two-kernel backward (forced via a tiny

@@ -80,6 +80,7 @@ def _sse_events(rest):
             if l.startswith(b"data: ")]
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_stream_buffered_health_and_errors(frontend, model):
     """One frontend, the whole happy+error surface: SSE tokens ==
     buffered tokens == serving.generate, /healthz, 404, 400."""

@@ -33,6 +33,7 @@ def _models_with_same_weights(**kw):
     return ref, scan
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_forward_parity_vs_per_layer():
     ref, scan = _models_with_same_weights()
     ref.eval(), scan.eval()
@@ -74,6 +75,7 @@ def test_trainstep_scan_model_trains():
     assert step.params["gpt.h_stack.qkv_w"].shape[0] == 2
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_trainstep_loss_parity_vs_per_layer():
     from paddle_tpu.jit import TrainStep
     ref, scan = _models_with_same_weights()
@@ -90,6 +92,7 @@ def test_trainstep_loss_parity_vs_per_layer():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_stack_vjp_mode_loss_parity():
     from paddle_tpu.jit import TrainStep
     ref, scan = _models_with_same_weights()
@@ -107,6 +110,7 @@ def test_stack_vjp_mode_loss_parity():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_decode_cache_parity():
     ref, scan = _models_with_same_weights()
     ref.eval(), scan.eval()
@@ -123,6 +127,7 @@ def test_decode_cache_parity():
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_recompute_scan_matches_plain():
     ref, scan = _models_with_same_weights(use_recompute=True)
     scan.train()
@@ -142,6 +147,7 @@ def test_recompute_scan_matches_plain():
     np.testing.assert_allclose(vals[0], vals[1], rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_dropout_trains_without_error():
     paddle.seed(0)
     cfg = _tiny(True)

@@ -50,6 +50,7 @@ def test_save_then_load_without_class(tmp_path):
     np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_load_in_fresh_process(tmp_path):
     prefix, x, want = _export(tmp_path)
     np.save(os.path.join(str(tmp_path), "x.npy"), x)

@@ -704,6 +704,43 @@ def test_publisher_thread_loop_and_final_publish():
     assert 3 in docs
 
 
+class _WedgedStore:
+    """store.set sleeps long enough to wedge the publisher loop inside
+    it; counts concurrent set() calls to catch the stop-final race."""
+
+    def __init__(self, delay):
+        self.delay = delay
+        self.active = 0
+        self.max_active = 0
+        self._lock = threading.Lock()
+
+    def set(self, key, value):
+        with self._lock:
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+        time.sleep(self.delay)
+        with self._lock:
+            self.active -= 1
+
+
+def test_publisher_stop_bounded_and_final_never_races_wedged_loop():
+    """Regression (TPU603/tpu-race introduction): stop() on a publisher
+    wedged inside a store op must stay bounded AND must not fire the
+    final publish concurrently with the wedged one — two unsynchronized
+    set()s on the same key published a torn/stale exit snapshot, and
+    `published` was bumped from two threads without a lock."""
+    store = _WedgedStore(delay=0.6)
+    pub = aggregate.HostPublisher(store, host=0, interval=0.01).start()
+    deadline = time.time() + 5.0
+    while store.active == 0 and time.time() < deadline:
+        time.sleep(0.005)            # loop thread is now inside set()
+    assert store.active == 1
+    t0 = time.time()
+    pub.stop(timeout=0.05, final=True)
+    assert time.time() - t0 < 0.5    # bounded: join timeout honored
+    assert store.max_active == 1     # final publish skipped, no overlap
+
+
 def test_cluster_cli_exit_code_discipline():
     from paddle_tpu.distributed.store import TCPStore
     from paddle_tpu.observability.__main__ import main

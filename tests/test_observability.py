@@ -151,6 +151,7 @@ def test_catalog_entries_are_well_formed():
         assert isinstance(spec["labels"], tuple), name
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     """THE catalog ratchet (ISSUE 11 satellite): exercise every
     instrumented subsystem, then assert BOTH directions —

@@ -123,6 +123,7 @@ def test_set_printoptions():
         np.set_printoptions(precision=8)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_gpt_recompute_parity():
     """use_recompute must not change the loss (same math, less memory)."""
     import paddle_tpu.nn as nn

@@ -185,6 +185,7 @@ def test_paged_vs_slotted_greedy_decode_bit_identical(scan_layers):
         "paged greedy decode diverged from slotted"
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_engine_paged_decode_parity_every_position():
     m = _tiny_model()
     eng = _engine(m)
@@ -298,6 +299,7 @@ def _greedy_stream(eng, slot, first_tok, n):
     return toks
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_cow_mutating_one_sharer_never_perturbs_another():
     """Two requests share prefix pages (including the capped tail page,
     whose final-token write copy-on-writes at admission); each then
@@ -393,6 +395,7 @@ def test_chunked_prefill_matches_one_shot():
         "chunked prefill must be ONE program"
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_chunked_prefill_interleaves_with_decode_tpot():
     """TPOT non-interference: while a long prompt admits chunk-by-chunk,
     the in-flight request KEEPS generating (one decode per scheduler

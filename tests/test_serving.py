@@ -85,6 +85,7 @@ def test_model_level_batched_prefill_then_decode():
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_engine_decode_parity_every_position():
     from paddle_tpu.serving.engine import DecodeEngine
     m = _tiny_model()
@@ -367,6 +368,7 @@ def test_sampled_tokens_are_int32():
     assert int(tok[0]) == int(np.argmax(np.asarray(logits[0])))  # greedy
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_sampling_uses_threaded_key_not_global_stream():
     import jax
     import jax.numpy as jnp
@@ -451,6 +453,7 @@ def test_predictor_generate_artifact_backed_raises():
             fn()
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_generate_prompt_shapes():
     # a flat 1-D prompt (list OR array OR Tensor) is ONE prompt, never N
     # single-token prompts; 2-D Tensors row-split like 2-D arrays

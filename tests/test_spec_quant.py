@@ -124,6 +124,7 @@ def test_int8_paged_engine_logits_parity_every_position(scan_layers):
     assert eng.prefill_compile_count == 1
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @pytest.mark.parametrize("scan_layers", [False, True])
 def test_int8_slotted_engine_logits_parity(scan_layers):
     """The slotted A/B layout gains kv_dtype=int8 too (bucketed prefill
@@ -165,6 +166,7 @@ def test_int8_model_level_paged_cache_parity():
     assert int(np.asarray(cache.lengths)[0]) == 8
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_int8_prefix_sharing_and_cow_preserve_scales():
     """CoW copies the scale pages with the code pages: two sharers of a
     quantized tail page decode independently with correct dequant."""
@@ -218,6 +220,7 @@ def _run_sched(m, prompts, spec_k, kv_dtype=None, temperature=0.0,
     return [res[r] for r in rids], eng
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_greedy_bit_identical_across_churn_and_prefix_hits():
     """The acceptance criterion: greedy output through the speculative
     verify program equals non-speculative decode EXACTLY — with more
@@ -239,6 +242,7 @@ def test_spec_greedy_bit_identical_across_churn_and_prefix_hits():
         assert eng.decode_compile_count <= 1
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_greedy_bit_identical_through_preemption_resume():
     """A tight pool forces recompute preemption mid-run; the resumed
     requests' greedy completions still match the uncontended
@@ -260,6 +264,7 @@ def test_spec_greedy_bit_identical_through_preemption_resume():
     assert eng.verify_compile_count == 1
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_greedy_bit_identical_scan_layers():
     """The verify program is a multi-token walk through the same cache
     views — the natively-stacked scan_layers layout must verify
@@ -272,6 +277,7 @@ def test_spec_greedy_bit_identical_scan_layers():
     assert eng.verify_compile_count == 1
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_eos_truncation_matches_non_spec():
     """EOS inside an accepted draft run must end the request exactly
     where sequential decode would."""
@@ -285,6 +291,7 @@ def test_spec_eos_truncation_matches_non_spec():
     np.testing.assert_array_equal(s2[0].tokens, b2[0].tokens)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_int8_composed_greedy_matches_int8_decode():
     """Both levers at once: spec over the int8 pool must equal the int8
     non-spec stream bit-for-bit (same quantized cache math, greedy)."""
@@ -299,6 +306,7 @@ def test_spec_int8_composed_greedy_matches_int8_decode():
     assert str(eng.cache.k.dtype) == "int8"
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_spec_near_max_len_caps_acceptance_in_program():
     """A slot whose remaining capacity is smaller than k: acceptance is
     clamped in-program (no garbage logits past the cache cap) and the
@@ -315,6 +323,7 @@ def test_spec_near_max_len_caps_acceptance_in_program():
 # accept-rate extremes + compile stability
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_compile_once_across_accept_rate_extremes():
     """All-accept and all-reject verify steps are traced-value paths of
     ONE program: feeding perfect drafts and adversarial garbage drafts
@@ -511,6 +520,7 @@ def test_kv_quant_error_gauge_opt_in(monkeypatch):
     assert eng2._track_qerr is False
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_kv_bytes_per_token_halved_under_int8():
     """The bench acceptance line at engine level: per-token decode KV
     bytes under int8 are <= 0.55x the unquantized bf16-equivalent —

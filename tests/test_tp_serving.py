@@ -79,6 +79,7 @@ def _greedy_drive(eng, prompts, steps=6):
 # parity: tp=2 == tp=1, both layer layouts, int8+spec composition
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @needs_two
 @pytest.mark.parametrize("scan_layers", [False, True])
 def test_tp2_greedy_parity_every_position(scan_layers):
@@ -100,6 +101,7 @@ def test_tp2_greedy_parity_every_position(scan_layers):
             np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @needs_two
 def test_tp2_int8_spec_composed_matches_tp1():
     """All three multiplicative levers composed: tp=2 over the int8 pool
@@ -126,6 +128,7 @@ def test_tp2_int8_spec_composed_matches_tp1():
         "tp=2 int8+spec completions diverged from tp=1"
 
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @needs_two
 def test_tp2_scan_layers_scheduler_drive():
     """scan_layers + tp through the full scheduler (chunked prefill,
@@ -218,6 +221,7 @@ def test_tp2_decode_hlo_s64_free_and_partitioned():
 # per-chip accounting
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 @needs_two
 def test_kv_accounting_reports_per_chip_truth():
     m = _tiny_model()
@@ -432,6 +436,7 @@ def test_tpu503_spmd_checks_catch_mismatch_and_inert_sharding():
                      "program produced no TPU503 finding"
     assert any("num_partitions" in f.message for f in findings)
 
+@pytest.mark.slow   # tier-1 wall budget: runs unfiltered in CI (see ci.yml)
 def test_tp2_overlapped_loop_parity_and_compile_once(monkeypatch):
     """ISSUE 13 x ISSUE 12: the overlapped loop's device-token threading
     on a SHARDED engine — the threaded (committed, mesh-replicated)
