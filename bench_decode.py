@@ -52,6 +52,15 @@ below runs as one matrix, one JSON line each):
   XLA_FLAGS=--xla_force_host_platform_device_count).  `tp` is a
   trajectory cursor field: tp=1 and tp=2 series never gate against
   each other.
+* `--kv-host on|off` (comma list, ISSUE 17) — the host-RAM KV page
+  tier.  Every paged line appends a repeat-prompt phase (device prefix
+  cache forced cold, the shared prompt re-admitted through one fresh
+  scheduler) and emits `repeat_ttft_ms` + `host_hit_pages`: the tier-on
+  arm must re-admit as a full prefix hit pulled back from host RAM
+  (`host_hit_pages` > 0 — enforced), the tier-off arm recomputes.  When
+  both arms run one configuration, the repeat drains' greedy output is
+  asserted bit-identical.  `kv_host` is a trajectory cursor field:
+  on and off series never gate against each other.
 
 On TPU: GPT-2 345M at serving shapes (8 slots, 1024-token cache).
 On CPU: a tiny head_dim-64 config (`tiny_d64`), so the bench always
@@ -71,7 +80,8 @@ import numpy as np
 
 
 def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
-               overlap: bool = True, trace_file: str = None):
+               overlap: bool = True, trace_file: str = None,
+               kv_host: bool = False):
     import jax
 
     import paddle_tpu as paddle
@@ -124,7 +134,11 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
                           seed=0, paged=paged, page_size=page_size,
                           kv_dtype=("int8" if kv_dtype == "int8"
                                     else None),
-                          spec_k=spec, tracer=tracer, tp=tp)
+                          spec_k=spec, tracer=tracer, tp=tp,
+                          # tiered KV A/B (ISSUE 17): 0 pins the tier OFF
+                          # regardless of PADDLE_TPU_KV_HOST_BYTES so the
+                          # off arm is a true baseline
+                          kv_host_bytes=(256 << 20) if kv_host else 0)
     rng = np.random.default_rng(0)
     # one shared "system prompt" a third of the requests reuse — the
     # prefix-sharing path must be ON the timed path, not a dead feature
@@ -213,6 +227,7 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
         "spec": spec,
         "tp": tp,
         "overlap": overlap,
+        "kv_host": "on" if kv_host else "off",
         "host_gap_ms_per_step": round(host_gap_ms, 4),
         # the ISSUE-7/8/12 acceptance line: decode KV bytes read per
         # generated token PER CHIP — `paged` scales with TRUE lengths
@@ -287,13 +302,53 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
                 str(r.rid): int(counts.get(r.trace_id, 0))
                 for r in results.values()},
         }
+    # repeat-prompt A/B (ISSUE 17): force the device prefix cache cold —
+    # tier ON spills the cached pages to host RAM first, tier OFF just
+    # drops them — then re-admit the shared prompt.  The tier-on line
+    # must re-admit as a full prefix hit served from host RAM
+    # (host_hit_pages > 0); the tier-off line recomputes.  main()
+    # asserts the repeat drains' greedy output bit-identical across the
+    # two arms — the tier must change WHERE the KV comes from, never
+    # what gets generated.
+    repeat_info = None
+    if paged:
+        hits0 = obs.counter("serving.kv_host_hits").value
+        if kv_host:
+            engine.spill_cached_pages()
+        else:
+            engine._alloc.drop_prefix_cache()
+        rsched = ContinuousBatchingScheduler(engine, overlap=overlap)
+        rsched.submit(Request(prompt=shared_prompt,
+                              max_new_tokens=max_new, temperature=0.0))
+        rres = rsched.run()
+        rr = next(iter(rres.values()))
+        hit_pages = int(obs.counter("serving.kv_host_hits").value - hits0)
+        if kv_host and hit_pages <= 0:
+            raise SystemExit(
+                "bench_decode: --kv-host on repeat admission pulled 0 "
+                "pages from the host tier — the tier is not serving")
+        repeat_gap_ms = 1e3 * rsched.host_gap_seconds \
+            / max(rsched.decode_steps_total, 1)
+        result["repeat_ttft_ms"] = round(1e3 * float(rr.ttft), 3)
+        result["host_hit_pages"] = hit_pages
+        result["repeat_host_gap_ms_per_step"] = round(repeat_gap_ms, 4)
+        repeat_info = {"tokens": tuple(int(t) for t in rr.tokens),
+                       "ttft_ms": result["repeat_ttft_ms"],
+                       "hit_pages": hit_pages}
+        # the repeat drain is where the kv programs first compile (the
+        # spill's kv_export, the fetch's kv_import) — refresh the
+        # watchdog block built above so the schema gate can hold them
+        # to their budget of exactly 1
+        result["metrics"]["compile_counts"] = {
+            k: v for k, v in obs.compile_counts().items() if v > 0}
     print(json.dumps(result))
     sys.stdout.flush()
     # cross-mode A/B hooks for main(): the sync-vs-overlapped greedy
-    # bit-parity assert and the host-gap reduction check
+    # bit-parity assert, the host-gap reduction check, and the kv-host
+    # repeat-prompt parity check
     tokens_by_rid = tuple(tuple(int(t) for t in results[r].tokens)
                           for r in sorted(results))
-    return tokens_by_rid, host_gap_ms
+    return tokens_by_rid, host_gap_ms, repeat_info
 
 
 def main(argv=None):
@@ -329,6 +384,16 @@ def main(argv=None):
                          "configuration, greedy output is asserted "
                          "bit-identical and the overlapped host-gap/"
                          "step must not exceed the sync one")
+    ap.add_argument("--kv-host", default="off",
+                    help="comma list of on|off: the host-RAM KV page "
+                         "tier (ISSUE 17; paged only).  Every paged "
+                         "line runs a repeat-prompt phase (device cache "
+                         "forced cold, shared prompt re-admitted) and "
+                         "emits repeat_ttft_ms + host_hit_pages; when "
+                         "BOTH arms run a configuration, the repeat "
+                         "drains' greedy output is asserted "
+                         "bit-identical — the tier changes where the KV "
+                         "comes from, never what gets generated")
     ap.add_argument("--trace-file", default=None, metavar="PATH",
                     help="export a request-scoped span trace (JSONL) of "
                          "the timed drain; feed it to `python -m "
@@ -380,27 +445,42 @@ def main(argv=None):
             ap.error("--overlap values must be on or off, got %r" % tok)
         overlaps.append(tok == "on")
 
-    configs = [(paged, kv_dtype, spec, tp, ov)
+    kv_hosts = []
+    for tok in str(args.kv_host).split(","):
+        tok = tok.strip().lower()
+        if tok not in ("on", "off"):
+            ap.error("--kv-host values must be on or off, got %r" % tok)
+        kv_hosts.append(tok == "on")
+
+    configs = [(paged, kv_dtype, spec, tp, ov, kh)
                for paged in layouts
                for kv_dtype in kv_dtypes
                for spec in specs
                for tp in tps
                for ov in overlaps
-               # speculation AND tensor parallelism are paged-only
-               if not ((spec or tp > 1) and not paged)]
+               for kh in kv_hosts
+               # speculation, tensor parallelism and the host KV tier
+               # are paged-only
+               if not ((spec or tp > 1 or kh) and not paged)]
     if not configs:
         # e.g. --slotted --spec 4: silently emitting ZERO lines would
         # make a CI pipe fail later with an opaque empty-stdin error
         ap.error("no runnable configuration: speculative decode "
-                 "(--spec > 0) and tensor parallelism (--tp > 1) need "
-                 "the paged layout")
-    ab = {}          # (paged, kv, spec, tp) -> {overlap: (tokens, gap)}
-    for paged, kv_dtype, spec, tp, ov in configs:
+                 "(--spec > 0), tensor parallelism (--tp > 1) and the "
+                 "host KV tier (--kv-host on) need the paged layout")
+    ab = {}    # (paged, kv, spec, tp, kv_host) -> {overlap: (tokens, gap)}
+    rep = {}   # (paged, kv, spec, tp, overlap) -> {kv_host: repeat_info}
+    for paged, kv_dtype, spec, tp, ov, kh in configs:
         # run_config resets the registry and resyncs the watchdog after
         # its own warmup drain, so no inter-config state scrub is needed
-        tokens, gap = run_config(paged, kv_dtype, spec, tp=tp, overlap=ov,
-                                 trace_file=args.trace_file)
-        ab.setdefault((paged, kv_dtype, spec, tp), {})[ov] = (tokens, gap)
+        tokens, gap, repeat = run_config(paged, kv_dtype, spec, tp=tp,
+                                         overlap=ov, kv_host=kh,
+                                         trace_file=args.trace_file)
+        ab.setdefault((paged, kv_dtype, spec, tp, kh), {})[ov] = \
+            (tokens, gap)
+        if repeat is not None:
+            rep.setdefault((paged, kv_dtype, spec, tp, ov), {})[kh] = \
+                repeat
     # sync-vs-overlapped A/B (the ISSUE-13 acceptance): when both modes
     # ran one configuration, greedy output must be BIT-IDENTICAL and
     # the overlapped loop's host gap must not exceed the sync loop's
@@ -423,6 +503,24 @@ def main(argv=None):
         print("bench_decode: sync-vs-overlapped A/B ok for %r — greedy "
               "bit-identical, host-gap/step %.4f -> %.4f ms"
               % (key, gap_s, gap_o), file=sys.stderr)
+    # kv-host on-vs-off A/B (the ISSUE-17 acceptance): when both arms
+    # ran one configuration, the repeat-prompt drains' greedy output
+    # must be BIT-IDENTICAL — a host-tier splice that changed a token
+    # means the fetch corrupted the cache it claims to restore.
+    for key, arms in rep.items():
+        if len(arms) < 2:
+            continue
+        off, on = arms[False], arms[True]
+        if off["tokens"] != on["tokens"]:
+            raise SystemExit(
+                "bench_decode: kv-host on-vs-off repeat-prompt greedy "
+                "output DIVERGED for config %r — the host-tier fetch "
+                "spliced wrong KV" % (key,))
+        print("bench_decode: kv-host A/B ok for %r — repeat greedy "
+              "bit-identical, repeat TTFT %.3f (recompute) vs %.3f ms "
+              "(host tier, %d pages fetched)"
+              % (key, off["ttft_ms"], on["ttft_ms"], on["hit_pages"]),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,8 @@ class RoleRegistry:
 _FRONTEND = "paddle_tpu.serving.frontend"
 _SCHED = "paddle_tpu.serving.scheduler"
 _DISAGG = "paddle_tpu.serving.disagg"
+_KVT = "paddle_tpu.serving.kv_tier"
+_CACHE = "paddle_tpu.serving.cache"
 _CKPT = "paddle_tpu.incubate.checkpoint"
 _LIVE = "paddle_tpu.observability.liveness"
 _AGG = "paddle_tpu.observability.aggregate"
@@ -114,6 +116,7 @@ DEFAULT_REGISTRY = RoleRegistry(
             f"{_CKPT}:CheckpointManager._drain",
             f"{_CKPT}:CheckpointManager._drain_remaining",
             f"{_AGG}:HostPublisher._run",
+            f"{_KVT}:ClusterPrefixIndex._run",
             f"{_STORE}:_PyStoreServer._accept",
             f"{_STORE}:_PyStoreServer._serve",
         ),
@@ -133,6 +136,9 @@ DEFAULT_REGISTRY = RoleRegistry(
             f"{_AGG}:HostPublisher.start",
             f"{_AGG}:HostPublisher.stop",
             f"{_AGG}:HostPublisher.publish_once",
+            f"{_KVT}:ClusterPrefixIndex.start",
+            f"{_KVT}:ClusterPrefixIndex.stop",
+            f"{_KVT}:ClusterPrefixIndex.publish_once",
             f"{_LIVE}:LivenessMonitor.start",
             f"{_LIVE}:LivenessMonitor.stop",
             f"{_LIVE}:LivenessMonitor.check_now",
@@ -158,6 +164,12 @@ DEFAULT_REGISTRY = RoleRegistry(
             "ready-guarded first-token fetch: int(dev) runs only after "
             "dev.is_ready() returned True, so the cast never blocks the "
             "loop",
+        f"{_CACHE}:np_native_view":
+            "host staging primitive of the spill/handoff/host-fetch "
+            "paths: asarray materializes exported KV rows once per "
+            "interleaved chunk (disagg handoff or kv_tier spill/fetch), "
+            "never on a decode dispatch — the chunk IS the allowlisted "
+            "transfer",
     },
     shared_fields={
         (f"{_CKPT}:CheckpointManager", "_err"):

@@ -109,6 +109,29 @@ CATALOG = {
         "gauge", "requests queued for or mid KV handoff (the bounded "
         "handoff queue plus in-flight transfers)"),
 
+    # -- tiered KV host cache (serving/kv_tier.py — ISSUE 17) ---------------
+    "serving.kv_host_bytes": _m(
+        "gauge", "host-RAM page-tier occupancy of the most recent spill/"
+        "invalidation (bounded by PADDLE_TPU_KV_HOST_BYTES; 0 = tier "
+        "off or empty)", unit="bytes"),
+    "serving.kv_host_hits": _m(
+        "counter", "host-tier pages pulled back through kv_import and "
+        "adopted device-side for an admission that missed the device "
+        "prefix cache (a hit is a page that LANDED — torn fetches "
+        "count nothing)"),
+    "serving.kv_host_misses": _m(
+        "counter", "admissions whose prompt had uncovered pages at the "
+        "device-coverage boundary and the host tier held none of them "
+        "(counted once per admission attempt, not per poll)"),
+    "serving.kv_host_spilled_pages": _m(
+        "counter", "refcount-0 hash-reachable pages exported to the "
+        "host tier (allocator reclaim spills + explicit cold-page "
+        "spills)"),
+    "serving.kv_tier_fetch_seconds": _m(
+        "histogram", "begin -> last page adopted of one host-tier "
+        "fetch (interleaved between decode steps; the repeat-prompt "
+        "TTFT includes this window)", unit="seconds"),
+
     # -- serving front-end (serving/frontend.py — ISSUE 13) -----------------
     "serving.http_requests": _m(
         "counter", "HTTP requests by response status code (200 stream/"

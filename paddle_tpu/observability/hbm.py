@@ -117,6 +117,19 @@ def _kv_pool_total() -> float:
     return total
 
 
+def _kv_host_total() -> float:
+    """Summed host-RAM KV tier occupancy of live serving engines (ISSUE
+    17) — the ledger's host-side row next to the device pool's, so one
+    flight dump shows where every cached KV byte lives."""
+    total = 0.0
+    for e in list(_ENGINES):
+        try:
+            total += float(e.kv_host_bytes_used())
+        except Exception:
+            continue
+    return total
+
+
 class HbmLedger:
     """The armed ledger: gauges + the chrome counter-mark ring."""
 
@@ -255,6 +268,7 @@ def ledger_state(top_n: int = TOP_ARRAYS) -> Dict[str, Any]:
         out["live_bytes_total"] = sum(per.values())
         out["top_arrays"] = _top_arrays(top_n)
         out["kv_pool_bytes"] = _kv_pool_total()
+        out["kv_host_bytes"] = _kv_host_total()
     except Exception as e:
         out["error"] = repr(e)
     led = _ACTIVE

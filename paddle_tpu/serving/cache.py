@@ -85,6 +85,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # the int8 grid lives with the q8 kernels (ONE canonical definition —
 # the autotune runners synthesize operands through the same math, so the
@@ -94,7 +95,29 @@ from ..kernels.decode_attention import dequantize_kv, quantize_kv
 
 __all__ = ["SlottedKVCache", "DecodeView", "PrefillView", "PagedKVCache",
            "PagedDecodeView", "PagedPrefillChunkView", "is_cache_view",
-           "quantize_kv", "dequantize_kv"]
+           "quantize_kv", "dequantize_kv", "np_native_view",
+           "np_restore_view"]
+
+
+def np_native_view(a):
+    """``(host array, original dtype)`` with the array viewed in an
+    npz-serializable dtype.  npz cannot round-trip ml_dtypes — a
+    bfloat16 pool saves as void ``|V2`` and reloads unusable — so
+    non-numpy-native pool dtypes serialize as a byte-exact unsigned
+    view; :func:`np_restore_view` undoes it.  The KV spill transports
+    (``serving/kv_tier.py``, ``serving/disagg.py``) share this pair so
+    their staging files can never drift in dtype handling."""
+    a = np.asarray(a)
+    dt = a.dtype
+    if dt.kind not in "fiu":
+        a = a.view("u%d" % dt.itemsize)
+    return a, dt
+
+
+def np_restore_view(a, dtype):
+    """Undo :func:`np_native_view`: reinterpret the loaded bytes in the
+    original (possibly non-native) dtype."""
+    return a.view(dtype) if a.dtype != dtype else a
 
 
 def _as_kv_dtypes(kv_dtype):

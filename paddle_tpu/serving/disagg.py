@@ -59,9 +59,7 @@ watchdog).
 from __future__ import annotations
 
 import os
-import tempfile
 import time
-import zipfile
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -72,6 +70,7 @@ from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
 from ..robustness.faultpoints import declare as _declare, faultpoint
 from .engine import PagePoolExhausted, PrefillTask
+from .kv_tier import TRANSPORT_ERRORS, npz_roundtrip
 from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
 
 __all__ = ["DisaggScheduler", "HandoffTask"]
@@ -95,9 +94,9 @@ _liveness.declare_beacon(
     "interleaved between decode steps", deadline=600.0)
 
 #: transport errors a handoff chunk treats as "the transfer failed —
-#: requeue and recompute" (ConnectionResetError is an OSError; EOFError/
-#: ValueError/BadZipFile are what reading a torn spill file raises)
-_TRANSPORT_ERRORS = (OSError, EOFError, ValueError, zipfile.BadZipFile)
+#: requeue and recompute" — the ONE failure model shared with the
+#: host-tier fetch transport (serving/kv_tier.py owns the definition)
+_TRANSPORT_ERRORS = TRANSPORT_ERRORS
 
 
 class HandoffTask:
@@ -469,48 +468,15 @@ class DisaggScheduler(ContinuousBatchingScheduler):
             self._handoff_chunk(task)
 
     def _spill_roundtrip(self, bufs, rid, chunk_idx):
-        """The host-staging transport: spill the chunk to a ``.npz``,
-        fire the chaos site with the file path (TornFile truncates it —
-        a torn transport), read it back.  Raises the transport error a
-        torn/reset transfer produces.
-
-        npz cannot round-trip ml_dtypes (a bfloat16 pool saves as void
-        ``|V2`` and reloads unusable — which stage_handoff would raise
-        on and the abort path would MISREAD as a torn transport): non-
-        numpy-native dtypes spill as a byte-exact unsigned view and the
-        read-back restores the dtype."""
-        names = ("k", "v", "ks", "vs")
-        arrays, dtypes = {}, {}
-        for n, a in zip(names, bufs):
-            if a is None:
-                continue
-            a = np.asarray(a)
-            dtypes[n] = a.dtype
-            if a.dtype.kind not in "fiu":
-                a = a.view("u%d" % a.dtype.itemsize)
-            arrays[n] = a
-        fd, path = tempfile.mkstemp(suffix=".npz",
-                                    prefix="paddle_tpu_handoff_")
-        os.close(fd)
-        try:
-            np.savez(path, **arrays)
-            faultpoint(HANDOFF_SITE, rid=rid, chunk=chunk_idx, path=path)
-            with np.load(path) as doc:
-                out = []
-                for n in names:
-                    if n not in doc.files:
-                        out.append(None)
-                        continue
-                    a = doc[n]
-                    if a.dtype != dtypes[n]:
-                        a = a.view(dtypes[n])
-                    out.append(a)
-                return tuple(out)
-        finally:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+        """The host-staging transport — the SAME
+        :func:`~.kv_tier.npz_roundtrip` the host-tier fetch path uses
+        (one transport, two call sites, one failure model), fired here
+        through the ``serve.handoff`` chaos site with this handoff's
+        rid/chunk context.  Raises the transport error a torn/reset
+        transfer produces."""
+        return npz_roundtrip(bufs, HANDOFF_SITE,
+                             prefix="paddle_tpu_handoff_",
+                             rid=rid, chunk=chunk_idx)
 
     def _handoff_chunk(self, task: HandoffTask):
         """Move ONE chunk of ``task``'s pages: export on the prefill

@@ -210,7 +210,7 @@ class DecodeEngine:
                  paged=True, page_size=64, num_pages=None,
                  prefill_chunk=None, kv_dtype=None, spec_k=0,
                  spec_ngram=3, tracer=None, tp=1, device=None,
-                 handoff_pages=4):
+                 handoff_pages=4, kv_host_bytes=None):
         cfg = model.config
         self.model = model
         # request-scoped tracing (ISSUE 9): the engine lane carries one
@@ -354,6 +354,30 @@ class DecodeEngine:
                              donate, handoff_pages)
         else:
             self._init_slotted(cfg, min_bucket, donate)
+        # tiered KV host cache (ISSUE 17): a bounded host-RAM LRU behind
+        # the device pool.  Reclaimed (or explicitly cold) refcount-0
+        # cached pages spill through kv_export; a later hash-hit
+        # admission that misses the device cache pulls them back through
+        # kv_import.  Off unless a budget is given (param wins over the
+        # PADDLE_TPU_KV_HOST_BYTES env).
+        self._host_tier = None
+        self._kv_index = None     # ClusterPrefixIndex, attach_cluster_index
+        self._spill_buf = None    # spill's OWN persistent export buffer:
+                                  # the handoff buffer may be mid-transfer
+                                  # (staged but not yet imported) when a
+                                  # reclaim fires inside _alloc_dst, and
+                                  # re-donating it would tear the splice
+        self._m_host_bytes = _metrics.gauge("serving.kv_host_bytes")
+        self._m_host_misses = _metrics.counter("serving.kv_host_misses")
+        self._m_host_spill = _metrics.counter(
+            "serving.kv_host_spilled_pages")
+        if self.paged:
+            from .kv_tier import HostPageTier, host_bytes_default
+            budget = (int(kv_host_bytes) if kv_host_bytes is not None
+                      else host_bytes_default())
+            if budget > 0:
+                self._host_tier = HostPageTier(budget)
+                self._alloc.spill_hook = self._spill_page
         # black-box flight recorder: dumps collect this engine's state
         # summary (weakref — registration never pins the engine); the
         # HBM ledger prices this engine's KV pool the same way
@@ -875,6 +899,14 @@ class DecodeEngine:
         self._state_src_leaves = new_leaves
         if self.paged:
             self._alloc.drop_prefix_cache()
+            if self._host_tier is not None:
+                # spilled rows were computed under the OLD parameters —
+                # a host hit would splice stale cache exactly like the
+                # device-hash hit the drop above prevents
+                if self._kv_index is not None:
+                    self._kv_index.withdraw(self._host_tier.digests())
+                self._host_tier.clear()
+                self._m_host_bytes.set(0)
         # tensor-parallel engines must RE-SHARD the changed snapshot:
         # post-training leaves are committed to their training
         # placement, and the sharded entries' in_shardings raise a
@@ -1142,7 +1174,9 @@ class DecodeEngine:
                 task.first_token_dev = tok
             task.last_logits = logits
             # publish this prompt's pages for later admissions to share
-            self._alloc.register_prefix(task.slot, task.ids)
+            servable = self._alloc.register_prefix(task.slot, task.ids)
+            if self._kv_index is not None and servable:
+                self._kv_index.offer(servable)
         return task.done
 
     def prefill(self, slot, token_ids, temperature=1.0, top_k=0,
@@ -1490,6 +1524,16 @@ class DecodeEngine:
         decode engine (``stage_handoff``) before the next export call
         donates the storage again (device execution order makes an
         already-dispatched stage safe)."""
+        return self._export_pages_into("_handoff_buf", page_ids)
+
+    def _export_pages_into(self, buf_attr, page_ids):
+        """Shared export body: gather ``page_ids`` through the ONE
+        compiled kv_export program into the persistent buffer named by
+        ``buf_attr``.  The handoff path and the host-tier spill path use
+        separate persistent buffers (same program — jit caches on
+        shape/dtype/sharding, not array identity): a spill can fire from
+        an allocator reclaim WHILE a handoff chunk sits staged, and
+        re-donating the handoff buffer there would tear the splice."""
         self._require_paged("export_pages")
         n = len(page_ids)
         if not 0 < n <= self.handoff_pages:
@@ -1497,8 +1541,9 @@ class DecodeEngine:
                              "got %d" % (self.handoff_pages, n))
         ids = np.zeros((self.handoff_pages,), np.int32)
         ids[:n] = np.asarray(page_ids, np.int32)
-        if self._handoff_buf is None:
-            self._handoff_buf = self._new_handoff_buf()
+        buf = getattr(self, buf_attr)
+        if buf is None:
+            buf = self._new_handoff_buf()
         tr_on = self._tracer.enabled
         if tr_on:
             c0 = self._kv_export.compile_count
@@ -1506,11 +1551,11 @@ class DecodeEngine:
         with x64_scope(False), self._trace_scope():
             out = self._kv_export(self.cache.k, self.cache.v,
                                   *self._cache_scale_args(),
-                                  *self._handoff_buf, jnp.asarray(ids))
+                                  *buf, jnp.asarray(ids))
         if tr_on:
             self._dispatch_span("engine.kv_export", self._kv_export,
                                 t0_ns, c0)
-        self._handoff_buf = list(out)
+        setattr(self, buf_attr, list(out))
         return tuple(out)
 
     def stage_handoff(self, bufs):
@@ -1576,6 +1621,151 @@ class DecodeEngine:
         rows included — ``kv_row_bytes`` truth), for the handoff
         accounting."""
         return int(n_pages) * self.page_size * self.kv_row_bytes()
+
+    # ------------------------------------------------------------------
+    # tiered KV host cache (ISSUE 17) — spill / fetch-plan / staging.
+    # The scheduler owns the interleaved chunk advance (kv_tier fetch
+    # machinery mirrors the disagg handoff discipline).
+    # ------------------------------------------------------------------
+
+    def _spill_page(self, pid, digests):
+        """Allocator spill hook (also the explicit cold-page path):
+        export one refcount-0 page's K/V rows — int8 codes + scales
+        included — through the compiled kv_export program into the host
+        tier under every chained digest the page is reachable by, so a
+        later host hit implies exact-prefix equality.  The one blocking
+        device->host copy lives here, on the rare reclaim path — never
+        on a decode dispatch."""
+        tier = self._host_tier
+        if tier is None or not digests:
+            return
+        out = self._export_pages_into("_spill_buf", [pid])
+        # row 0 of the spill buffer is our page; np.asarray is the
+        # device->host gather (full logical heads even under tp)
+        arrays = {}
+        for name, a in zip(("k", "v", "ks", "vs"), out):
+            if a is not None:
+                arrays[name] = np.asarray(a[0])
+        stored = False
+        for d in digests:
+            stored = tier.put(d, arrays) or stored
+        if stored:
+            self._m_host_spill.inc()
+            self._m_host_bytes.set(tier.bytes_used())
+            if self._kv_index is not None:
+                self._kv_index.offer(digests)
+
+    def spill_cached_pages(self, limit=None):
+        """Explicit cold-page policy: proactively export up to ``limit``
+        free-but-cached (refcount-0, hash-reachable) pages to the host
+        tier and return them to the truly-free list — the long-context
+        lever (cold mid-context pages spill, the hot tail stays
+        resident) and the bench's device-miss/host-hit forcing lever.
+        Returns the number of pages evicted from the device cache."""
+        self._require_paged("spill_cached_pages")
+        if self._host_tier is None:
+            raise RuntimeError(
+                "spill_cached_pages needs a host tier (kv_host_bytes "
+                "argument or PADDLE_TPU_KV_HOST_BYTES)")
+        pids = list(self._alloc._cached)
+        if limit is not None:
+            pids = pids[:int(limit)]
+        for pid in pids:
+            digests = self._alloc._page_hashes.get(pid)
+            if digests:
+                self._spill_page(pid, frozenset(digests))
+            self._alloc.evict_cached(pid)
+        return len(pids)
+
+    def host_fetch_plan(self, ids):
+        """``[(page_index, digest)]`` of contiguous host-tier pages that
+        would extend the device-resident coverage of prompt ``ids`` —
+        what the scheduler pulls back (chunked, interleaved between
+        decode steps) before admitting the request as a full prefix hit.
+        Empty when the tier is off/cold or the device cache already
+        covers everything the tier could add; counts one kv_host_miss
+        when the tier was consulted at the coverage boundary and had
+        nothing (called once per admission attempt, so misses count
+        admissions, not polls)."""
+        tier = self._host_tier
+        if tier is None or not self.paged:
+            return []
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        full, tail = self._alloc._prompt_digests(ids)
+        entries = list(enumerate(full))
+        if tail is not None:
+            entries.append((len(full), tail))
+        plan = []
+        consulted = False
+        for idx, d in entries:
+            if d in self._alloc._hash_to_page:
+                continue            # device-resident — nothing to fetch
+            consulted = True
+            if d in tier:
+                plan.append((idx, d))
+            else:
+                break               # contiguity: stop at the first hole
+        if consulted and not plan:
+            self._m_host_misses.inc()
+        return plan
+
+    def host_fetch_stage(self, digests, rid=None, chunk=0):
+        """Stage one fetch chunk (up to ``handoff_pages`` host-tier
+        entries): read the tier arrays, assemble a transfer-buffer-shaped
+        host chunk, push it through the chaos-instrumented npz staging
+        roundtrip (``serve.kv_tier`` faultpoint — a torn read surfaces
+        here), and place it on this engine's devices.  Returns the
+        staged arrays; they are NOT donated until ``import_pages``, so
+        ``is_ready()`` polling is safe.  Raises ``KeyError`` when a tier
+        entry vanished (LRU raced the fetch) or a ``TRANSPORT_ERRORS``
+        member on a torn staging read — the scheduler's abort path owns
+        both."""
+        from .kv_tier import BUF_NAMES, KV_TIER_SITE, npz_roundtrip
+        self._require_paged("host_fetch_stage")
+        n = len(digests)
+        if not 0 < n <= self.handoff_pages:
+            raise ValueError("host_fetch_stage moves 1..%d pages per "
+                             "chunk, got %d" % (self.handoff_pages, n))
+        tier = self._host_tier
+        if tier is None:
+            raise RuntimeError("host_fetch_stage needs a host tier")
+        pool_shape, scale_shape = self._handoff_buf_shapes()
+        bufs = {"k": np.zeros(pool_shape, np.dtype(self.cache.k.dtype)),
+                "v": np.zeros(pool_shape, np.dtype(self.cache.v.dtype))}
+        if self._quantized:
+            bufs["ks"] = np.zeros(scale_shape, np.float32)
+            bufs["vs"] = np.zeros(scale_shape, np.float32)
+        for i, d in enumerate(digests):
+            arrays = tier.get(d)
+            if arrays is None:
+                raise KeyError("host-tier entry vanished mid-fetch "
+                               "(LRU eviction raced the fetch)")
+            for name in bufs:
+                bufs[name][i] = arrays[name]
+        tup = tuple(bufs.get(name) for name in BUF_NAMES)
+        tup = npz_roundtrip(tup, KV_TIER_SITE, rid=rid, chunk=chunk)
+        return self.stage_handoff(tup)
+
+    def kv_host_bytes_used(self):
+        """Host-tier occupancy in bytes (0 when the tier is off) — the
+        HBM ledger's host-side row."""
+        tier = self._host_tier
+        return 0 if tier is None else tier.bytes_used()
+
+    def attach_cluster_index(self, store, host=None, interval=None,
+                             start=True):
+        """Wire a TCPStore-backed ClusterPrefixIndex to this engine:
+        every digest that becomes servable (registered device-side or
+        spilled to the host tier) is offered to the publisher, so
+        replicas share one logical system-prompt cache and a router can
+        read the cluster's prefix map.  Returns the index (started as a
+        daemon unless ``start=False``)."""
+        from .kv_tier import ClusterPrefixIndex
+        self._kv_index = ClusterPrefixIndex(store, host=host,
+                                            interval=interval)
+        if start:
+            self._kv_index.start()
+        return self._kv_index
 
     def slot_lengths(self):
         """Per-slot valid lengths.  Paged mode serves the host mirror —
@@ -1677,6 +1867,8 @@ class DecodeEngine:
                              for j in np.nonzero(al.mapped[i])[0]]
                     for i in range(self.num_slots)},
             )
+            if self._host_tier is not None:
+                st["kv_host"] = self._host_tier.state()
         return st
 
     # -- compile accounting (the "compiles exactly once" contract) ---------

@@ -1,0 +1,396 @@
+"""Tiered KV cache: the host-RAM page tier behind the device pool, plus
+the cluster-wide prefix index on top of it.
+
+The device page pool is the ONLY prefix cache the engine had until now:
+a refcount-0 cached page survives exactly until the free list runs dry
+and the allocator reclaims it (PR 7).  When millions of requests share
+system-prompt templates, those cached prefix bytes are the dominant
+bytes and repeat-prompt TTFT is the headline SLI — so evicted pages
+should fall to host RAM, not to recompute.  Three pieces live here:
+
+* :class:`HostPageTier` — a bounded LRU of spilled pages, keyed by the
+  PR-7 **chained content digest**, so a host hit implies exact-prefix
+  equality (the same guarantee the device hash cache gives; no token
+  comparison is ever needed on the readmit path).  Entries are plain
+  host numpy copies of one page's K/V rows — int8 codes + scales
+  included — exactly what one row of the ``kv_export`` handoff buffer
+  holds.  Budget: ``PADDLE_TPU_KV_HOST_BYTES`` (0/unset = tier off).
+* :func:`npz_roundtrip` — the shared host-staging transport: write the
+  arrays to a temp ``.npz``, fire the chaos site with the file path
+  (``TornFile`` truncates it, ``BitFlip`` corrupts it — ``np.load``
+  verifies zip CRCs, so both surface as :data:`TRANSPORT_ERRORS`), read
+  them back.  ``serving/disagg.py``'s handoff spill path and the host-
+  tier fetch path are the SAME function — one transport, two call
+  sites, one failure model.
+* :class:`ClusterPrefixIndex` — every host periodically publishes its
+  resident digest set to the PR-4 distributed store under
+  ``paddle_tpu/kv_index/<host>`` (the PR-13 telemetry discipline:
+  ``publish_once()`` is the unit the thread loops over, the store
+  client's retry policy covers transient resets, and a publish that
+  still fails is logged and skipped — the index must never take down
+  serving).  Replicas thereby share one logical system-prompt cache
+  view, and the future prefix-affinity router gets its routing table
+  for free.
+
+Failure discipline: a torn host-tier read (the ``serve.kv_tier``
+faultpoint) aborts the fetch, frees the chunk's freshly allocated pages
+refcount-exactly, discards the torn tier entries (each retry fetches
+strictly fewer pages — termination is structural), and degrades to
+recompute through the scheduler's requeue-at-front path.  A fetch can
+be slow or lost; it can never corrupt a splice.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import zipfile
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Set
+
+import numpy as np
+
+from ..observability import liveness as _liveness
+from ..robustness.faultpoints import declare as _declare, faultpoint
+
+__all__ = [
+    "KV_TIER_SITE", "TRANSPORT_ERRORS", "INDEX_KEY_PREFIX",
+    "npz_roundtrip", "HostPageTier", "ClusterPrefixIndex", "fetch_index",
+    "host_bytes_default",
+]
+
+#: chaos site on the host-tier fetch transport: fires between the
+#: staging write and its read-back with ``ctx['path']`` = the staging
+#: file, so TornFile/BitFlip model a torn host-tier read; the scheduler
+#: must degrade the fetch to recompute, never splice corrupt rows
+KV_TIER_SITE = _declare(
+    "serve.kv_tier",
+    "fires once per host-tier fetch chunk, between the staged npz write "
+    "and its read-back (ctx['path'] = the staging file, so TornFile/"
+    "BitFlip model a torn host-tier read)")
+
+#: liveness beacon over one fetch phase (stage or ready-polled import):
+#: a wedged device_put or staging read produces a stall dump naming it
+_liveness.declare_beacon(
+    "serve.kv_tier",
+    "one host-tier fetch phase (tier read -> npz roundtrip -> stage, or "
+    "the ready-polled import commit), interleaved between decode steps",
+    deadline=600.0)
+
+#: transport errors one tier/handoff transfer treats as "the transfer
+#: failed — requeue and recompute" (ConnectionResetError is an OSError;
+#: EOFError/ValueError/BadZipFile are what reading a torn or bit-flipped
+#: staging file raises — np.load verifies zip CRCs)
+TRANSPORT_ERRORS = (OSError, EOFError, ValueError, zipfile.BadZipFile)
+
+#: store key prefix; one key per host, newest digest snapshot wins
+#: (set() overwrites — the view is "current residency", not a history)
+INDEX_KEY_PREFIX = "paddle_tpu/kv_index/"
+
+#: bound on digests one host remembers for publication (oldest dropped
+#: past it — the index is advisory; a dropped digest only costs a
+#: remote miss, never correctness)
+INDEX_MAX_DIGESTS = 65536
+
+_FORMAT = "paddle_tpu-kv-index-v1"
+
+#: handoff-buffer array names, in export/stage order (ks/vs None for an
+#: unquantized pool)
+BUF_NAMES = ("k", "v", "ks", "vs")
+
+
+def host_bytes_default() -> int:
+    """The env-configured host-tier budget (0 = tier off).  Degrade
+    loudly but safely: a typo'd knob disables the tier rather than
+    crashing engine construction."""
+    raw = os.environ.get("PADDLE_TPU_KV_HOST_BYTES", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(int(float(raw)), 0)
+    except ValueError:
+        sys.stderr.write("[kv_tier] ignoring unparseable "
+                         "PADDLE_TPU_KV_HOST_BYTES=%r\n" % (raw,))
+        return 0
+
+
+def npz_roundtrip(bufs, site, prefix="paddle_tpu_kv_", **ctx):
+    """The shared host-staging transport: spill ``bufs`` (the
+    ``(k, v, ks, vs)`` handoff-buffer tuple, scale entries None for an
+    unquantized pool) to a temp ``.npz``, fire the chaos ``site`` with
+    the file path (TornFile truncates it, BitFlip corrupts it — a torn
+    transport), read it back.  Raises one of :data:`TRANSPORT_ERRORS`
+    when the transfer tore.
+
+    npz cannot round-trip ml_dtypes (a bfloat16 pool saves as void
+    ``|V2`` and reloads unusable — which stage_handoff would raise on
+    and the abort path would MISREAD as a torn transport): non-numpy-
+    native dtypes spill as a byte-exact unsigned view and the read-back
+    restores the dtype (``serving/cache.py`` owns the view helpers)."""
+    from .cache import np_native_view, np_restore_view
+    arrays, dtypes = {}, {}
+    for n, a in zip(BUF_NAMES, bufs):
+        if a is None:
+            continue
+        arrays[n], dtypes[n] = np_native_view(a)
+    fd, path = tempfile.mkstemp(suffix=".npz", prefix=prefix)
+    os.close(fd)
+    try:
+        np.savez(path, **arrays)
+        faultpoint(site, path=path, **ctx)
+        with np.load(path) as doc:
+            out = []
+            for n in BUF_NAMES:
+                if n not in doc.files:
+                    out.append(None)
+                    continue
+                out.append(np_restore_view(doc[n], dtypes[n]))
+            return tuple(out)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+class HostPageTier:
+    """Bounded host-RAM store of spilled KV pages, LRU over chained
+    content digests.
+
+    One entry is one page's rows as a ``{"k", "v"[, "ks", "vs"]}`` dict
+    of host numpy arrays (what one row of the ``kv_export`` buffer
+    holds).  A page reachable under several digests (full + partial-tail
+    registrations) stores one entry per digest sharing the SAME arrays;
+    the byte ledger prices each entry's nbytes, so shared storage is
+    over- rather than under-counted — the budget is a ceiling, never a
+    leak.  Thread-safe: the allocator spills from whatever thread ran
+    ``alloc()``, the scheduler fetches from its loop, and the flight
+    recorder reads occupancy from a dump thread."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            budget_bytes = host_bytes_default()
+        self.budget_bytes = max(int(budget_bytes), 0)
+        self._lock = threading.Lock()
+        # digest -> {"arrays": {name: np.ndarray}, "nbytes": int}
+        self._entries: "OrderedDict" = OrderedDict()
+        self._bytes = 0
+        self.spilled = 0        # entries admitted (lifetime)
+        self.lru_evicted = 0    # entries LRU-evicted over budget
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    @staticmethod
+    def _entry_bytes(arrays: Dict[str, np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in arrays.values())
+
+    def put(self, digest, arrays: Dict[str, np.ndarray]) -> bool:
+        """Admit one page's rows under ``digest`` (newest end of the
+        LRU), evicting oldest entries past the byte budget.  An entry
+        bigger than the whole budget is refused — admitting it would
+        empty the tier for a page that immediately evicts itself."""
+        if not self.enabled:
+            return False
+        nb = self._entry_bytes(arrays)
+        if nb > self.budget_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= old["nbytes"]
+            self._entries[digest] = {"arrays": arrays, "nbytes": nb}
+            self._bytes += nb
+            self.spilled += 1
+            while self._bytes > self.budget_bytes:
+                _d, ev = self._entries.popitem(last=False)
+                self._bytes -= ev["nbytes"]
+                self.lru_evicted += 1
+        return True
+
+    def get(self, digest) -> Optional[Dict[str, np.ndarray]]:
+        """The page rows under ``digest`` (an LRU touch), or None."""
+        with self._lock:
+            ent = self._entries.get(digest)
+            if ent is None:
+                return None
+            self._entries.move_to_end(digest)
+            return ent["arrays"]
+
+    def __contains__(self, digest) -> bool:
+        with self._lock:
+            return digest in self._entries
+
+    def discard(self, digest):
+        """Drop ``digest`` (torn-read hygiene: a digest that fed a
+        failed fetch must not feed the retry — each abort shrinks the
+        next plan, so degradation to recompute terminates)."""
+        with self._lock:
+            ent = self._entries.pop(digest, None)
+            if ent is not None:
+                self._bytes -= ent["nbytes"]
+
+    def clear(self):
+        """Drop everything (engine ``refresh_state`` on a parameter
+        change: spilled rows from old weights must never splice)."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def digests(self) -> List:
+        """Snapshot of resident digests, LRU order (oldest first)."""
+        with self._lock:
+            return list(self._entries)
+
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def state(self) -> Dict[str, int]:
+        """JSON-ready occupancy row for flight dumps / ledger_state."""
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "budget_bytes": self.budget_bytes,
+                    "spilled": self.spilled,
+                    "lru_evicted": self.lru_evicted}
+
+
+def _host_id(host: Optional[int]) -> int:
+    if host is not None:
+        return int(host)
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def _hex(digest) -> str:
+    return digest.hex() if isinstance(digest, (bytes, bytearray)) \
+        else str(digest)
+
+
+class ClusterPrefixIndex:
+    """Publishes this host's resident chained page digests to the
+    distributed store under ``paddle_tpu/kv_index/<host>`` so replicas
+    share one logical prefix-cache view.
+
+    The PR-13 ``HostPublisher`` discipline: :meth:`publish_once` is the
+    unit the background thread loops over (tests call it directly), the
+    store client already wraps every op in the retry policy, and a
+    publish that still fails after retries is logged and skipped — the
+    index is advisory and must never take down serving.  ``offer()`` is
+    cheap and lock-guarded; the engine calls it at prefix registration
+    and spill time from whatever thread ran them."""
+
+    def __init__(self, store, host: Optional[int] = None,
+                 interval: Optional[float] = None):
+        self.store = store
+        self.host = _host_id(host)
+        if interval is None:
+            v = _liveness._env_float("PADDLE_TPU_KV_INDEX_INTERVAL")
+            interval = v if v is not None else 10.0
+        self.interval = float(interval)
+        self.published = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # guards `_digests` (offered from engine/scheduler threads,
+        # snapshotted by the publisher thread) and `published`
+        self._lock = threading.Lock()
+        # insertion-ordered digest set, oldest dropped past the cap
+        self._digests: "OrderedDict" = OrderedDict()
+
+    @property
+    def key(self) -> str:
+        return INDEX_KEY_PREFIX + str(self.host)
+
+    def offer(self, digests: Iterable):
+        """Remember digests now resident on this host (device pool or
+        host tier) for the next publication."""
+        with self._lock:
+            for d in digests:
+                h = _hex(d)
+                self._digests.pop(h, None)
+                self._digests[h] = None
+                while len(self._digests) > INDEX_MAX_DIGESTS:
+                    self._digests.popitem(last=False)
+
+    def withdraw(self, digests: Iterable):
+        """Forget digests (tier clear / torn-entry discard)."""
+        with self._lock:
+            for d in digests:
+                self._digests.pop(_hex(d), None)
+
+    def publish_once(self) -> str:
+        with self._lock:
+            digests = list(self._digests)
+        doc = {"format": _FORMAT, "host": self.host, "pid": os.getpid(),
+               "wall_ts": time.time(), "digests": digests}
+        self.store.set(self.key, json.dumps(doc, sort_keys=True).encode())
+        with self._lock:
+            self.published += 1
+        return self.key
+
+    def start(self) -> "ClusterPrefixIndex":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="kv-index-publisher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0, final: bool = True):
+        """Stop the loop; ``final=True`` publishes one last snapshot so
+        peers hold this host's exit-time residency."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                # wedged inside a store op: publishing now would race it
+                # on the same key — skip the final publish, stay bounded
+                sys.stderr.write("[kv_tier] index publisher still busy "
+                                 "after %.1fs; skipping final publish\n"
+                                 % timeout)
+                self._thread = None
+                return
+        self._thread = None
+        if final:
+            try:
+                self.publish_once()
+            except Exception as e:
+                sys.stderr.write("[kv_tier] final index publish failed: "
+                                 "%r\n" % (e,))
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.publish_once()
+            except Exception as e:
+                # RetryError after the store policy gave up, or a torn
+                # store: drop THIS snapshot, keep the loop alive
+                sys.stderr.write("[kv_tier] index publish failed "
+                                 "(skipping this interval): %r\n" % (e,))
+
+
+def fetch_index(store, world_size: int) -> Dict[int, Set[str]]:
+    """{host: set(hex digests)} for every host that published; hosts
+    that never published (or published garbage) are simply absent — the
+    index is advisory, a missing host only costs remote misses."""
+    out: Dict[int, Set[str]] = {}
+    for h in range(int(world_size)):
+        try:
+            raw = store.get(INDEX_KEY_PREFIX + str(h), wait=False)
+            doc = json.loads(raw.decode("utf-8"))
+            if doc.get("format") != _FORMAT:
+                raise ValueError("unknown kv-index format %r"
+                                 % doc.get("format"))
+            out[h] = set(doc.get("digests", ()))
+        except KeyError:
+            continue
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return out

@@ -85,6 +85,12 @@ class PageAllocator:
         self._hash_to_page: Dict[bytes, int] = {}
         self._page_hashes: Dict[int, Set[bytes]] = {}
         self._device_table = None     # memoised jnp copy; None = dirty
+        # host-tier spill hook (serving/kv_tier.py wiring): called with
+        # (pid, frozenset of digests) just before a reclaim purges a
+        # hash-reachable page, so its rows can fall to host RAM instead
+        # of to recompute.  None = no tier.  Best-effort: a failed spill
+        # must never fail the allocation it rode on.
+        self.spill_hook = None
 
     # -- pool accounting ---------------------------------------------------
 
@@ -125,9 +131,22 @@ class PageAllocator:
             pid = self._free.pop()
         elif self._cached:
             # reclaim the oldest cached page: purge its digests so the
-            # rewritten page is never reachable under stale content
+            # rewritten page is never reachable under stale content —
+            # but first offer it to the host tier (it is refcount-0 and
+            # hash-reachable: exactly the page a repeat prompt would
+            # have hit)
             pid = next(iter(self._cached))
             del self._cached[pid]
+            if self.spill_hook is not None:
+                digests = self._page_hashes.get(pid)
+                if digests:
+                    try:
+                        self.spill_hook(pid, frozenset(digests))
+                    except Exception as e:
+                        import sys
+                        sys.stderr.write("[kv_tier] spill of page %d "
+                                         "failed (reclaiming anyway): "
+                                         "%r\n" % (pid, e))
             self._purge_hashes(pid)
             self._tracer.instant("pages.reclaim", page=pid,
                                  cached_left=len(self._cached))
@@ -194,6 +213,37 @@ class PageAllocator:
         self._free.extend(self._cached)
         self._cached.clear()
 
+    def evict_cached(self, pid: int):
+        """Purge one free-but-cached page to the truly-free list (the
+        explicit cold-page path: the engine spills its rows to the host
+        tier FIRST, then calls this so the device copy stops being
+        hash-reachable — the content survives, the HBM does not)."""
+        if pid not in self._cached:
+            raise ValueError("page %d is not free-but-cached" % pid)
+        del self._cached[pid]
+        self._purge_hashes(pid)
+        self._free.append(pid)
+        self._device_table = None
+
+    def adopt_page(self, pid: int, digests):
+        """Register a freshly imported page (the host-tier fetch
+        landing) as free-but-cached content: reachable under
+        ``digests`` and immediately shareable by the admission that
+        triggered the fetch — exactly the state a released,
+        hash-registered page is in.  ``pid`` must have come from
+        :meth:`alloc` (refcount 1, unmapped); adoption parks it at
+        refcount 0 on the cached list."""
+        if self.refcount[pid] != 1:
+            raise AssertionError("adopt_page expects a fresh alloc "
+                                 "(page %d refcount %d)"
+                                 % (pid, int(self.refcount[pid])))
+        self.refcount[pid] = 0
+        self._cached[pid] = None
+        s = self._page_hashes.setdefault(pid, set())
+        for d in digests:
+            self._hash_to_page[d] = pid
+            s.add(d)
+
     # -- copy-on-write -----------------------------------------------------
 
     def needs_cow(self, slot: int, idx: int) -> bool:
@@ -256,17 +306,26 @@ class PageAllocator:
     def register_prefix(self, slot: int, ids: np.ndarray):
         """Publish a fully-prefilled slot's prompt pages for sharing.
         Digests already registered (e.g. the shared pages this slot
-        itself mapped) are left pointing at their existing page."""
+        itself mapped) are left pointing at their existing page.
+        Returns every digest now servable for this prompt (newly
+        registered or pre-existing) — the engine offers them to the
+        cluster prefix index when one is attached."""
         full_digests, tail_digest = self._prompt_digests(ids)
         entries = list(enumerate(full_digests))
         if tail_digest is not None:
             entries.append((len(full_digests), tail_digest))
+        servable = []
         for idx, d in entries:
-            if d in self._hash_to_page or not self.mapped[slot, idx]:
+            if d in self._hash_to_page:
+                servable.append(d)
+                continue
+            if not self.mapped[slot, idx]:
                 continue
             pid = int(self.table[slot, idx])
             self._hash_to_page[d] = pid
             self._page_hashes.setdefault(pid, set()).add(d)
+            servable.append(d)
+        return servable
 
     # -- device mirror -----------------------------------------------------
 

@@ -104,12 +104,16 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability import hbm as _hbm
 from ..observability import liveness as _liveness
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
 from ..robustness.faultpoints import declare as _declare, faultpoint
 from .engine import PagePoolExhausted, PrefillTask
+# importing the tier module also declares its faultpoint site and
+# liveness beacon (the scheduler fetches the beacon handle at init)
+from .kv_tier import TRANSPORT_ERRORS as _TIER_ERRORS
 from .spec import propose as _propose_draft
 
 __all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
@@ -229,6 +233,33 @@ class _Inflight:
         self.t0_ns = t0_ns
 
 
+class _HostFetch:
+    """One in-progress host-tier page fetch (ISSUE 17): the queue-head
+    request's prompt misses the device prefix cache but hits the
+    host-RAM tier, so its pages are being pulled back through
+    ``kv_import`` chunk by chunk — interleaved between decode steps,
+    ``is_ready()``-polled, never blocking a decode dispatch.  While the
+    fetch runs the request lives HERE (not in ``waiting``, not in a
+    slot); completion requeues it at the queue FRONT, where the next
+    admission's prefix lookup finds every fetched page device-resident
+    and admits in one 1-token chunk.  ``_submit_t`` stays in place
+    throughout — TTFT includes the fetch, honestly."""
+
+    __slots__ = ("req", "plan", "pos", "staged", "staged_digests",
+                 "pages_in", "chunk_idx", "span", "t0")
+
+    def __init__(self, req, plan, span, t0):
+        self.req = req
+        self.plan = plan              # [(page_index, digest)] to pull
+        self.pos = 0                  # plan entries imported so far
+        self.staged = None            # staged device arrays, or None
+        self.staged_digests = None    # the digests the staging covers
+        self.pages_in = 0             # pages landed (the hits metric)
+        self.chunk_idx = 0            # faultpoint/trace chunk counter
+        self.span = span              # "kv_tier" request child span
+        self.t0 = t0                  # fetch begin, perf_counter
+
+
 class ContinuousBatchingScheduler:
     # page-pressure evictions per request before the scheduler stops
     # requeueing it and finishes it "cache_full" — bounds wasted
@@ -321,6 +352,15 @@ class ContinuousBatchingScheduler:
             "serving.finished_requests", ("reason",))
         self._m_occupancy = _metrics.gauge("serving.slot_occupancy")
         self._m_queue_depth = _metrics.gauge("serving.queue_depth")
+        # tiered KV host-cache fetches (ISSUE 17): rid -> _HostFetch.
+        # The scheduler owns the hit counter (a hit is a page that
+        # LANDED) and the fetch histogram; the engine owns the
+        # spill/miss/occupancy side.
+        self._fetches: Dict[int, _HostFetch] = {}
+        self._m_host_hits = _metrics.counter("serving.kv_host_hits")
+        self._m_fetch_s = _metrics.histogram(
+            "serving.kv_tier_fetch_seconds")
+        self._kvt_beacon = _liveness.beacon("serve.kv_tier")
         # liveness beacon, fetched ONCE: disabled (the default) it is
         # the module NOOP_BEACON by identity — the per-iteration guard
         # is then two empty method calls (tests assert the identity)
@@ -561,6 +601,13 @@ class ContinuousBatchingScheduler:
             # a request whose prompt+budget exceeds max_len is still
             # admissible — generation just ends early with "cache_full"
             if self.engine.paged:
+                if (req.rid not in self._preempted
+                        and self._begin_host_fetch(req)):
+                    # diverted to the host-tier fetch lane: the slot
+                    # stays free this round (a later request may take
+                    # it next iteration — accepted FIFO relaxation
+                    # while the head's pages stream back in)
+                    continue
                 self._admit_paged(idx, req)
                 n += 1
                 continue
@@ -595,6 +642,142 @@ class ContinuousBatchingScheduler:
             self._m_occupancy.set(
                 sum(a is not None for a in self.slots))
         return n
+
+    # -- tiered KV host-cache fetch (ISSUE 17) -----------------------------
+    # The disagg handoff discipline, pointed at a tier instead of a
+    # second engine: one phase per fetch per iteration (stage, then
+    # ready-poll, then import+adopt), interleaved between decode steps
+    # so a fetch in flight never blocks a decode dispatch.
+
+    def _begin_host_fetch(self, req) -> bool:
+        """Divert the popped queue-head request into the fetch lane when
+        the host tier can extend its device-resident prefix coverage.
+        Preemption resumes never divert (their recompute ids already
+        mostly prefix-hit their own still-cached pages)."""
+        plan = self.engine.host_fetch_plan(req.prompt)
+        if not plan:
+            return False
+        root = self._req_spans.get(req.rid, _tracing.NOOP_SPAN)
+        span = self._tracer.span("kv_tier", parent=root,
+                                 pages=len(plan))
+        self._fetches[req.rid] = _HostFetch(req, plan, span,
+                                            time.perf_counter())
+        self._m_queue_depth.set(len(self.waiting))
+        return True
+
+    def _fetch_advance(self):
+        """Advance every in-flight host-tier fetch by ONE phase."""
+        for rid in list(self._fetches):
+            f = self._fetches.get(rid)
+            if f is None:
+                continue
+            with self._kvt_beacon:
+                self._fetch_advance_one(rid, f)
+
+    def _fetch_advance_one(self, rid, f):
+        eng = self.engine
+        if f.staged is None:
+            # phase 1: read the tier entries, npz-roundtrip them through
+            # the serve.kv_tier chaos site, and dispatch the device
+            # placement (async — the poll below is the only wait)
+            digs = [d for _i, d in
+                    f.plan[f.pos:f.pos + eng.handoff_pages]]
+            try:
+                f.staged = eng.host_fetch_stage(digs, rid=rid,
+                                                chunk=f.chunk_idx)
+            except (KeyError,) + _TIER_ERRORS as e:
+                self._fetch_abort(rid, f, digs, e)
+                return
+            f.staged_digests = digs
+            f.chunk_idx += 1
+            return
+        # phase 2: non-blocking readiness poll — a chunk still in
+        # flight just waits another iteration, the decode loop keeps
+        # dispatching
+        if not all(a.is_ready() for a in f.staged if a is not None):
+            return
+        # phase 3: land the chunk — allocate destination pages, scatter
+        # through the ONE compiled kv_import program (donating the pool;
+        # device execution order sequences it against any in-flight
+        # decode step, the disagg discipline), and adopt each page as
+        # free-but-cached content reachable under its digest
+        digs = f.staged_digests
+        pids = self._fetch_alloc(rid, f, len(digs))
+        if pids is None:
+            return                 # aborted, or parked for pages
+        eng.import_pages(f.staged, pids)
+        eng._m_pool.set(eng._alloc.pages_used())
+        for pid, d in zip(pids, digs):
+            eng._alloc.adopt_page(pid, [d])
+        f.pages_in += len(digs)
+        f.pos += len(digs)
+        f.staged = None
+        f.staged_digests = None
+        if f.pos >= len(f.plan):
+            self._fetch_complete(rid, f)
+
+    def _fetch_alloc(self, rid, f, n):
+        """Allocate ``n`` destination pages for a fetch chunk.  Pool
+        pressure drains the in-flight decode step first (its
+        retirements may free pages); still dry, the fetch PARKS —
+        partial allocations released refcount-exactly, the chunk
+        retried next iteration once decodes retire — rather than
+        preempting active slots for a request that is still waiting.
+        A pool that cannot hold the chunk even empty aborts the fetch
+        to recompute."""
+        alloc = self.engine._alloc
+        pids = []
+        try:
+            for _ in range(n):
+                pids.append(alloc.alloc())
+            return pids
+        except PagePoolExhausted as e:
+            for pid in pids:
+                alloc._release(pid)
+            if self._drain_inflight():
+                return None        # retry next iteration
+            if any(a is not None for a in self.slots):
+                return None        # parked: decodes will free pages
+            self._fetch_abort(rid, f, f.staged_digests, e)
+            return None
+
+    def _fetch_abort(self, rid, f, digests, exc):
+        """A fetch chunk tore (transport error at the ``serve.kv_tier``
+        site, a vanished LRU entry, or an unservable pool): degrade to
+        recompute.  Earlier chunks' adopted pages REMAIN valid cached
+        content; the torn chunk's digests are discarded from the tier
+        so the retry's plan is strictly smaller — degradation
+        terminates structurally.  The request requeues at the queue
+        FRONT (the ``serve.handoff`` requeue discipline) and the next
+        admission recomputes whatever the tier no longer covers."""
+        tier = self.engine._host_tier
+        if tier is not None:
+            for d in digests or ():
+                tier.discard(d)
+            self.engine._m_host_bytes.set(tier.bytes_used())
+        _flight.record("kv_tier_abort", rid=rid,
+                       error=type(exc).__name__, chunk=f.chunk_idx,
+                       pages_in=f.pages_in, planned=len(f.plan))
+        _flight.crash_dump({"kind": "kv_tier_abort", "rid": rid,
+                            "error": repr(exc)})
+        f.span.end(aborted=True, error=type(exc).__name__,
+                   pages=f.pages_in)
+        del self._fetches[rid]
+        self.waiting.appendleft(f.req)
+        self._m_queue_depth.set(len(self.waiting))
+
+    def _fetch_complete(self, rid, f):
+        """Every planned page landed: requeue at the queue FRONT so the
+        next admission's prefix lookup finds the whole prompt device-
+        resident and admits it as a full prefix hit (one 1-token
+        chunk).  ``kv_host_hits`` counts pages that LANDED — the
+        honest hit metric."""
+        del self._fetches[rid]
+        self.waiting.appendleft(f.req)
+        self._m_host_hits.inc(f.pages_in)
+        self._m_fetch_s.observe(time.perf_counter() - f.t0)
+        f.span.end(pages=f.pages_in)
+        self._m_queue_depth.set(len(self.waiting))
 
     def _run_prefill_chunk(self, act, task, engine, evict, sync=True):
         """ONE chunked-prefill advance — span selection (recompute
@@ -919,6 +1102,7 @@ class ContinuousBatchingScheduler:
     def _step_inner(self) -> int:
         self._drained_n = 0
         self.admit()
+        self._fetch_advance()
         self.prefill_once()
         if self.overlap:
             prev = self._inflight
@@ -953,6 +1137,7 @@ class ContinuousBatchingScheduler:
         scheduler extends it with its prefill-side and handoff
         state)."""
         return bool(self.waiting
+                    or self._fetches
                     or any(a is not None for a in self.slots)
                     or self._inflight is not None)
 
@@ -990,6 +1175,31 @@ class ContinuousBatchingScheduler:
         # DISPATCH mask) never credits a freed lane
         self._drain_inflight()
         if rid in self.finished:       # the drain itself retired it
+            return True
+        f = self._fetches.pop(rid, None)
+        if f is not None:
+            # mid-fetch cancel: no device pages are held between phases
+            # (alloc+import+adopt are atomic within one phase call — a
+            # staged, unimported chunk holds only transfer buffers),
+            # and already-adopted pages are valid shared cache content
+            # that simply stays.  Fetches never cover preemption
+            # resumes, so there are no parked tokens to report.
+            f.span.end(aborted=True, error="cancelled",
+                       pages=f.pages_in)
+            self._submit_t.pop(rid, None)
+            res = RequestResult(
+                rid=rid, tokens=np.asarray([], np.int32),
+                finish_reason="cancelled", ttft=0.0, tpot=0.0,
+                trace_id=self._trace_ids.pop(rid, 0))
+            self.finished[rid] = res
+            ws = self._wait_spans.pop(rid, None)
+            if ws is not None:
+                ws.end()
+            self._req_spans.pop(rid, _tracing.NOOP_SPAN).end(
+                reason="cancelled", tokens=0)
+            self._m_finished.labels(reason="cancelled").inc()
+            if self._on_finish is not None:
+                self._on_finish(res)
             return True
         for idx, act in enumerate(self.slots):
             if act is not None and act.req.rid == rid:

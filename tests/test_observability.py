@@ -905,7 +905,8 @@ def test_bench_schema_rejects_malformed_lines():
 
 def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
                 metric="decode_tokens_per_sec", layout="paged",
-                kv_dtype=None, spec=None):
+                kv_dtype=None, spec=None, kv_host=None, repeat_ttft=None,
+                host_hit_pages=None):
     line = {"metric": metric, "value": value, "unit": "tok/s",
             "cache_layout": layout,
             "compile_counts": {"decode": decode_compiles, "prefill": 1},
@@ -917,6 +918,14 @@ def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
         line["kv_dtype"] = kv_dtype
     if spec is not None:
         line["spec"] = spec
+    if kv_host is not None:
+        line["kv_host"] = kv_host
+        if kv_host == "on" and host_hit_pages is None:
+            host_hit_pages = 2      # schema: an on line must have hits
+    if repeat_ttft is not None:
+        line["repeat_ttft_ms"] = repeat_ttft
+    if host_hit_pages is not None:
+        line["host_hit_pages"] = host_hit_pages
     p = tmp_path / name
     p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
                              "parsed": line}))
@@ -1026,6 +1035,55 @@ def test_trajectory_cursor_keys_on_kv_dtype_and_spec(tmp_path):
         _traj_entry(tmp_path, "BENCH_decode_r33.json", 895.0, "tpu"),
     ]
     assert bs.check_trajectory(legacy) == []
+
+
+def test_trajectory_kv_host_cursor_and_repeat_ttft_gate(tmp_path):
+    """ISSUE-17 cursor + gate: the --kv-host arms key their own cursors
+    (the on arm pacing differently than off is the point of the A/B,
+    not a regression), legacy lines without the field keep theirs, and
+    the repeat-prompt TTFT gate fails a like-for-like on-chip pair that
+    slid >3% — while staying disarmed on CPU smoke lines."""
+    bs = _bench_schema()
+    # on arm slower than the off arm it follows: different legs, no
+    # fail; a legacy (pre-tier) line in between keys its own cursor too
+    mixed = [
+        _traj_entry(tmp_path, "BENCH_decode_r41.json", 1000.0, "tpu",
+                    kv_host="off", repeat_ttft=40.0),
+        _traj_entry(tmp_path, "BENCH_decode_r42.json", 700.0, "tpu",
+                    kv_host="on", repeat_ttft=12.0),
+        _traj_entry(tmp_path, "BENCH_decode_r43.json", 950.0, "tpu"),
+    ]
+    assert bs.check_trajectory(mixed) == []
+    # a second on-arm round whose repeat TTFT slid >3% fails against
+    # the LAST on-arm entry, with the off arm and legacy lines between
+    mixed += [
+        _traj_entry(tmp_path, "BENCH_decode_r44.json", 1005.0, "tpu",
+                    kv_host="off", repeat_ttft=40.5),
+        _traj_entry(tmp_path, "BENCH_decode_r45.json", 702.0, "tpu",
+                    kv_host="on", repeat_ttft=14.0),
+    ]
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "repeat-prompt TTFT" in fails[0]
+    assert "BENCH_decode_r45" in fails[0] and "BENCH_decode_r42" in fails[0]
+    # CPU smoke never arms the repeat gate (compile-dominated window)
+    cpu = [
+        _traj_entry(tmp_path, "BENCH_decode_r51.json", 50.0, "cpu",
+                    kv_host="on", repeat_ttft=10.0),
+        _traj_entry(tmp_path, "BENCH_decode_r52.json", 50.0, "cpu",
+                    kv_host="on", repeat_ttft=300.0),
+    ]
+    assert bs.check_trajectory(cpu) == []
+    # line shape: an on line claiming zero host hits is rejected — the
+    # bench would be gating a tier that served nothing
+    with pytest.raises(bs.SchemaError, match="host_hit_pages"):
+        bs.validate_line({"metric": "decode_tokens_per_sec",
+                          "value": 1.0, "unit": "tok/s",
+                          "kv_host": "on", "host_hit_pages": 0},
+                         "<line>")
+    with pytest.raises(bs.SchemaError, match="kv_host"):
+        bs.validate_line({"metric": "decode_tokens_per_sec",
+                          "value": 1.0, "unit": "tok/s",
+                          "kv_host": True}, "<line>")
 
 
 def test_trajectory_mode_accepts_committed_repo_files():

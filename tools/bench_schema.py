@@ -58,15 +58,23 @@ filename), and these gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec, tp, overlap, disagg, qps, mix)`` cursor key matches
-  (the ISSUE-8 A/B matrix interleaves quantized/speculative lines in
-  one trajectory, ISSUE 12 adds the ``--tp`` axis, ISSUE 13 adds the
-  sync-vs-overlapped loop axis plus the serve harness's (QPS, mix)
-  operating points, and ISSUE 15 adds the colocated-vs-disaggregated
-  axis — a tp=2, sync-loop, disagg, or qps=16 line must never gate
-  against a different series), a >3% drop in ``value`` fails.  CPU
-  entries never perf-gate (smoke numbers), so the gate arms itself
-  automatically the first session that records chip numbers;
+  kv_dtype, spec, tp, overlap, kv_host, disagg, qps, mix)`` cursor key
+  matches (the ISSUE-8 A/B matrix interleaves quantized/speculative
+  lines in one trajectory, ISSUE 12 adds the ``--tp`` axis, ISSUE 13
+  adds the sync-vs-overlapped loop axis plus the serve harness's (QPS,
+  mix) operating points, ISSUE 15 adds the colocated-vs-disaggregated
+  axis, and ISSUE 17 adds the ``--kv-host`` tier axis — a tp=2,
+  sync-loop, disagg, kv-host-on, or qps=16 line must never gate
+  against a different series; legacy lines without a field keep their
+  own ``None``-keyed cursor, regression-tested), a >3% drop in
+  ``value`` fails.  CPU entries never perf-gate (smoke numbers), so
+  the gate arms itself automatically the first session that records
+  chip numbers;
+* **repeat-prompt TTFT (ISSUE 17)**: over the same like-for-like
+  on-chip decode pairs, >3% growth in ``repeat_ttft_ms`` fails — the
+  host-tier re-admission (or the tier-off recompute baseline) must not
+  slide while tokens/s holds.  Armed on-chip only: the CPU smoke's
+  repeat window is compile-dominated noise;
 * **serve latency (ISSUE 13)**: over the same like-for-like on-chip
   pairs of ``serve_goodput_tokens_per_sec`` lines, >3% growth in
   client-observed p99 TTFT fails — a PR that holds goodput by letting
@@ -243,6 +251,26 @@ def validate_line(doc: Any, path: str,
     if "vs_baseline" in doc:
         _require(_is_num(doc["vs_baseline"]), path,
                  "'vs_baseline' must be a number")
+    # ISSUE-17 optional fields (tiered KV host cache): absent on
+    # pre-tier lines (their own legacy cursor), validated when present
+    if "kv_host" in doc:
+        _require(doc["kv_host"] in ("on", "off"), path,
+                 "'kv_host' must be 'on' or 'off', got %r"
+                 % (doc["kv_host"],))
+    if "repeat_ttft_ms" in doc:
+        _require(_is_num(doc["repeat_ttft_ms"])
+                 and doc["repeat_ttft_ms"] >= 0, path,
+                 "'repeat_ttft_ms' must be a non-negative number")
+    if "host_hit_pages" in doc:
+        _require(isinstance(doc["host_hit_pages"], int)
+                 and not isinstance(doc["host_hit_pages"], bool)
+                 and doc["host_hit_pages"] >= 0, path,
+                 "'host_hit_pages' must be a non-negative int")
+    if doc.get("kv_host") == "on":
+        _require(doc.get("host_hit_pages", 0) >= 1, path,
+                 "a kv_host=on line must report host_hit_pages >= 1 — "
+                 "the repeat-prompt phase pulled nothing from the tier "
+                 "it claims to bench")
     if expect_cost:
         _require("cost" in doc, path,
                  "--expect-cost: the bench line carries no 'cost' block")
@@ -332,6 +360,11 @@ def _extract_line(doc: Any, path: str) -> Any:
 _COMPILE_ONCE = {
     "decode_tokens_per_sec": (("metrics", "serving.decode"),
                               ("metrics", "serving.spec_verify"),
+                              # ISSUE 17: the host-tier spill/fetch path
+                              # reuses the disagg page programs — budget
+                              # stays 1 each whenever the line ran them
+                              ("metrics", "serving.kv_export"),
+                              ("metrics", "serving.kv_import"),
                               ("top", "decode"),
                               ("top", "verify")),
     SERVE_METRIC: (("metrics", "serving.decode"),
@@ -347,6 +380,9 @@ REGRESSION_TOLERANCE = 0.03     # >3% on-chip drop fails
 MFU_TOLERANCE = 0.03            # >3% on-chip cost.mfu drop fails
 PEAK_HBM_TOLERANCE = 0.05      # >5% on-chip cost.peak_bytes growth fails
 TTFT_P99_TOLERANCE = 0.03      # >3% on-chip serve p99-TTFT growth fails
+REPEAT_TTFT_TOLERANCE = 0.03   # >3% on-chip repeat-prompt TTFT growth
+                               # fails (ISSUE 17; CPU smoke never gates —
+                               # its repeat window is compile-dominated)
 
 
 def check_trajectory(paths: List[str], write: str = None) -> List[str]:
@@ -378,10 +414,12 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "spec": line.get("spec"),
             "tp": line.get("tp"),
             "overlap": line.get("overlap"),
+            "kv_host": line.get("kv_host"),
             "disagg": line.get("disagg"),
             "qps": line.get("qps"),
             "mix": line.get("mix"),
             "ttft_p99_ms": line.get("ttft_p99_ms"),
+            "repeat_ttft_ms": line.get("repeat_ttft_ms"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
                 "compile_counts", line.get("compile_counts")),
             "cost": (line.get("cost")
@@ -426,8 +464,8 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                 continue
             key = (e.get("model"), e.get("cache_layout"),
                    e.get("kv_dtype"), e.get("spec"), e.get("tp"),
-                   e.get("overlap"), e.get("disagg"), e.get("qps"),
-                   e.get("mix"))
+                   e.get("overlap"), e.get("kv_host"), e.get("disagg"),
+                   e.get("qps"), e.get("mix"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
@@ -455,6 +493,27 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                                      prev["file"], prev["ttft_p99_ms"],
                                      e["ttft_p99_ms"],
                                      100 * TTFT_P99_TOLERANCE))
+            # gate 2c — repeat-prompt TTFT (ISSUE 17): like-for-like
+            # on-chip decode pairs gate the repeat-admission latency —
+            # a PR that keeps tokens/s but lets the host-tier (or
+            # recompute) repeat path slide fails.  kv_host is a cursor
+            # field, so the on and off arms each gate their own series;
+            # armed on-chip only (the loop's backend guard) — the CPU
+            # smoke's repeat window is compile-dominated noise.
+            if (prev is not None and _is_num(e.get("repeat_ttft_ms"))
+                    and _is_num(prev.get("repeat_ttft_ms"))
+                    and prev["repeat_ttft_ms"] > 0):
+                growth = e["repeat_ttft_ms"] / prev["repeat_ttft_ms"] \
+                    - 1.0
+                if growth > REPEAT_TTFT_TOLERANCE:
+                    failures.append(
+                        "%s: on-chip regression — repeat-prompt TTFT "
+                        "grew %.1f%% vs %s (%.3f -> %.3f ms; tolerance "
+                        "%.0f%%)" % (e["file"], 100 * growth,
+                                     prev["file"],
+                                     prev["repeat_ttft_ms"],
+                                     e["repeat_ttft_ms"],
+                                     100 * REPEAT_TTFT_TOLERANCE))
             # gate 3 — cost cursors (ISSUE 11): like-for-like on-chip
             # pairs also gate MFU (>3% drop) and peak HBM (>5% growth),
             # each against ITS OWN last-carrying anchor.
