@@ -28,6 +28,12 @@ over a package-wide call graph closed from the declared thread roots of
 the serving stack: ``python -m paddle_tpu.analysis --concurrency
 --strict``.  See the concurrency package docstring for TPU601-604.
 
+A fourth tier — tpu-flow, TPU7xx — lives in :mod:`.flow` and runs a
+per-function exception-edge dataflow (page lifetimes, retrace hazards,
+host/device mirror coherence) over the declared resource registry:
+``python -m paddle_tpu.analysis --flow --strict``.  See the flow
+package docstring for TPU701-703.
+
 Programmatic use::
 
     from paddle_tpu.analysis import Analyzer
@@ -49,6 +55,8 @@ from .trace import (TRACE_PASSES, TRACE_RULES, F32_ACCUM_OPS,
 from .concurrency import (CONCURRENCY_PASSES, CONCURRENCY_RULES,
                           ConcurrencyAnalyzer, DEFAULT_REGISTRY,
                           RoleRegistry)
+from .flow import (DEFAULT_FLOW_REGISTRY, FLOW_PASSES, FLOW_RULES,
+                   FlowAnalyzer, MirrorSpec, ResourceRegistry)
 
 #: default pass set, in rule-id order.
 ALL_PASSES = [HostSyncPass, X64WideningPass, CollectiveAxisPass,
@@ -63,4 +71,6 @@ __all__ = ["Analyzer", "FileContext", "Finding", "LintPass", "ProjectPass",
            "S64_COMPUTE_OPS", "TRACE_PASSES", "TRACE_RULES",
            "F32_ACCUM_OPS", "TraceAnalyzer", "TraceProgram",
            "CONCURRENCY_PASSES", "CONCURRENCY_RULES", "ConcurrencyAnalyzer",
-           "DEFAULT_REGISTRY", "RoleRegistry"]
+           "DEFAULT_REGISTRY", "RoleRegistry",
+           "DEFAULT_FLOW_REGISTRY", "FLOW_PASSES", "FLOW_RULES",
+           "FlowAnalyzer", "MirrorSpec", "ResourceRegistry"]

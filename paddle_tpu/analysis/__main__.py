@@ -7,9 +7,12 @@
 * tpu-race (concurrency): ``python -m paddle_tpu.analysis --concurrency
   [paths] [--strict]`` — the TPU6xx call-graph tier over the declared
   thread roles (paths scope the scanned tree, default ``paddle_tpu``).
+* tpu-flow (dataflow): ``python -m paddle_tpu.analysis --flow [paths]
+  [--strict]`` — the TPU7xx exception-edge dataflow tier over the
+  declared resource/pairing registry.
 
 ``--select`` filters rules within the chosen tier; ``--list-rules``
-prints the unified catalogue (rule, pass, tier, summary) for all three.
+prints the unified catalogue (rule, pass, tier, summary) for all four.
 
 ``--format json`` emits one machine-readable JSON document on stdout;
 ``--format github`` emits GitHub workflow annotation lines
@@ -26,7 +29,8 @@ import json
 import os
 import sys
 
-from . import ALL_PASSES, CONCURRENCY_RULES, RULES, TRACE_RULES, Analyzer
+from . import (ALL_PASSES, CONCURRENCY_RULES, FLOW_RULES, RULES,
+               TRACE_RULES, Analyzer)
 from .baseline import BaselineFormatError
 
 
@@ -93,16 +97,21 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", action="store_true",
                     help="run the concurrency tier (TPU6xx): package-wide "
                          "call-graph audit from the declared thread roles")
+    ap.add_argument("--flow", action="store_true",
+                    help="run the flow tier (TPU7xx): per-function "
+                         "exception-edge dataflow over the declared "
+                         "resource/pairing registry")
     ap.add_argument("--baseline", default="auto",
                     help="baseline file (default: "
                          "<root>/tools/tpu_lint_baseline.txt if present); "
                          "'none' disables")
     ap.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule ids to run (AST: %s; "
-                         "trace: %s; concurrency: %s)"
+                         "trace: %s; concurrency: %s; flow: %s)"
                          % (", ".join(sorted(RULES)),
                             ", ".join(sorted(TRACE_RULES)),
-                            ", ".join(sorted(CONCURRENCY_RULES))))
+                            ", ".join(sorted(CONCURRENCY_RULES)),
+                            ", ".join(sorted(FLOW_RULES))))
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
     ap.add_argument("--format", default="text",
@@ -114,21 +123,23 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        # one table across all three tiers: rule, pass, tier, summary
+        # one table across all four tiers: rule, pass, tier, summary
         for tier, cat in (("ast", RULES), ("trace", TRACE_RULES),
-                          ("concurrency", CONCURRENCY_RULES)):
+                          ("concurrency", CONCURRENCY_RULES),
+                          ("flow", FLOW_RULES)):
             for rule, cls in sorted(cat.items()):
                 print(f"{rule}  {cls.name:<18} {tier:<12} "
                       f"{cls.description}")
         return 0
 
-    if args.trace and args.concurrency:
-        print("--trace and --concurrency are separate tiers; "
+    if sum((args.trace, args.concurrency, args.flow)) > 1:
+        print("--trace, --concurrency and --flow are separate tiers; "
               "run them as separate invocations", file=sys.stderr)
         return 2
 
     catalogue = (TRACE_RULES if args.trace
-                 else CONCURRENCY_RULES if args.concurrency else RULES)
+                 else CONCURRENCY_RULES if args.concurrency
+                 else FLOW_RULES if args.flow else RULES)
     passes = None
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
@@ -157,6 +168,11 @@ def main(argv=None) -> int:
             from .concurrency import ConcurrencyAnalyzer
             analyzer = ConcurrencyAnalyzer(root=args.root, passes=passes,
                                            baseline_path=baseline)
+            report = analyzer.run(args.paths or None)
+        elif args.flow:
+            from .flow import FlowAnalyzer
+            analyzer = FlowAnalyzer(root=args.root, passes=passes,
+                                    baseline_path=baseline)
             report = analyzer.run(args.paths or None)
         else:
             analyzer = Analyzer(root=args.root, passes=passes,

@@ -271,11 +271,12 @@ class Analyzer:
                 baseline_path = None
         base = Baseline.load(baseline_path) if baseline_path \
             else Baseline([])
-        # TPU5xx entries belong to the trace tier (analysis.trace) and
-        # TPU6xx to the concurrency tier (analysis.concurrency) —
-        # excluded here so they are never reported stale by an AST run
+        # TPU5xx entries belong to the trace tier (analysis.trace),
+        # TPU6xx to the concurrency tier (analysis.concurrency) and
+        # TPU7xx to the flow tier (analysis.flow) — excluded here so
+        # they are never reported stale by an AST run
         self.baseline = base.subset(
-            lambda e: not e.rule.startswith(("TPU5", "TPU6")))
+            lambda e: not e.rule.startswith(("TPU5", "TPU6", "TPU7")))
 
     def run(self, paths: Sequence[str]) -> Report:
         report = Report([], [], [], [], [])

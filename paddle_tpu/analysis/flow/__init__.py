@@ -1,0 +1,44 @@
+"""tpu-flow — tier 4 of the static analysis stack: the exception-edge
+dataflow audit (rules TPU7xx).
+
+Where tier 1 (tpu-lint) checks each file's AST, tier 2 (tpu-audit) the
+traced programs, and tier 3 (tpu-race) the thread structure, this tier
+checks the *paths* through each serving function: a per-function CFG
+with explicit exception edges (:mod:`.cfg`) driven by a declarative
+resource/pairing registry (:mod:`.resources`), with three passes
+(:mod:`.rules`):
+
+=======  ===============================================================
+TPU701   page handle acquired but not released / transferred on every
+         path out of the function — **including raise edges** (the
+         leak-on-exception class PRs 7/12/14/16 each caught by hand)
+TPU702   watched jit entry called with an unbounded python scalar, or
+         a jitted closure over post-construction-rebound ``self``
+         state — the static complement of the recompile watchdog
+TPU703   host-side mirror write (``cache_len``/``_len_host``/page
+         table) without its paired device op in scope or a declared
+         delegation
+=======  ===============================================================
+
+Run it with ``python -m paddle_tpu.analysis --flow --strict``.
+Suppressions are the AST tier's, unchanged: inline
+``# tpu-lint: disable=TPU70x`` or a reasoned entry in
+``tools/tpu_lint_baseline.txt`` (TPU7xx entries are scoped to this
+tier — no other tier stale-flags them).  See ANALYSIS.md §Tier 4.
+"""
+from .cfg import CFG, EXIT, build_cfg, stmt_may_raise
+from .core import FlowAnalyzer
+from .resources import DEFAULT_REGISTRY as DEFAULT_FLOW_REGISTRY
+from .resources import MirrorSpec, ResourceRegistry
+from .rules import (FlowContext, FlowPass, MirrorCoherencePass,
+                    PageLifetimePass, RetraceHazardPass)
+
+FLOW_PASSES = [PageLifetimePass, RetraceHazardPass, MirrorCoherencePass]
+FLOW_RULES = {p.rule: p for p in FLOW_PASSES}
+
+__all__ = [
+    "CFG", "DEFAULT_FLOW_REGISTRY", "EXIT", "FLOW_PASSES", "FLOW_RULES",
+    "FlowAnalyzer", "FlowContext", "FlowPass", "MirrorCoherencePass",
+    "MirrorSpec", "PageLifetimePass", "ResourceRegistry",
+    "RetraceHazardPass", "build_cfg", "stmt_may_raise",
+]

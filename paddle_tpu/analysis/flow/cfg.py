@@ -1,0 +1,368 @@
+"""Per-function control-flow graph with explicit exception edges.
+
+The flow tier's passes are *path-sensitive* about one thing the AST and
+call-graph tiers cannot see: what happens on the paths an exception
+takes out of a function.  This module builds, per ``def``, a CFG whose
+nodes are **statements** (compound statements contribute their *header*
+— the ``if``/``while`` test, the ``for`` iterable, the ``with`` items —
+as one node and their bodies as further nodes) and whose edges come in
+two kinds:
+
+* ``succ`` — normal control transfer.  Dataflow along these edges uses
+  the statement's **post**-state (gen/kill applied).
+* ``exc`` — an exception raised *during* the statement.  Any statement
+  that contains a call (or is a ``raise``/``assert``) gets an ``exc``
+  edge to the innermost enclosing handler entry, through any enclosing
+  ``finally`` body, or — when nothing encloses it — to :data:`EXIT`.
+  Dataflow along these edges uses the statement's **pre**-state: an
+  acquisition that raises never bound its result.
+
+Deliberate, documented approximations (see ANALYSIS.md §Tier 4):
+
+* A ``try`` handler whose type is not a catch-all still receives an
+  edge from every raising statement in the body **and** the exception
+  is also propagated outward (may-analysis: both continuations exist).
+* ``finally`` bodies are modeled on the fall-through and exception
+  paths; an early ``return`` inside ``try``/``finally`` goes straight
+  to :data:`EXIT` without re-executing the modeled ``finally``.
+* ``with`` is control-flow only: ``__exit__`` cleanup semantics are not
+  modeled (the serving tree's page resources are not context managers).
+* Nested ``def``/``lambda``/``class`` bodies are opaque single nodes.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+__all__ = ["CFG", "EXIT", "build_cfg", "stmt_may_raise"]
+
+#: synthetic exit node id: normal ``succ`` edges into EXIT are returns /
+#: fall-off-the-end; ``exc`` edges into EXIT are uncaught exceptions.
+EXIT = -1
+
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+def _expr_may_raise(*exprs) -> bool:
+    """True when evaluating any of the expressions can raise: contains a
+    call (skipping lambda bodies, whose calls do not run at def site)."""
+    for e in exprs:
+        if e is None:
+            continue
+        stack = [e]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                return True
+            if isinstance(n, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def stmt_may_raise(stmt: ast.stmt) -> bool:
+    """May executing this statement's *header* raise?  Compound bodies
+    are separate nodes and judged on their own."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, ast.If) or isinstance(stmt, ast.While):
+        return _expr_may_raise(stmt.test)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return _expr_may_raise(stmt.iter)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _expr_may_raise(*[i.context_expr for i in stmt.items])
+    if isinstance(stmt, ast.Return):
+        return _expr_may_raise(stmt.value)
+    if isinstance(stmt, ast.Try):
+        return False
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return False                    # decorators at def-time: ignored
+    if isinstance(stmt, ast.Match):
+        return _expr_may_raise(stmt.subject)
+    return _expr_may_raise(stmt)
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _CATCH_ALL:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _CATCH_ALL:
+            return True
+    return False
+
+
+class _Target:
+    """Where exceptions raised under some region go.  Sources (raising
+    node ids) and sinks (handler-entry ids / outer targets / EXIT) both
+    arrive incrementally; the cross product is wired as they do."""
+
+    def __init__(self, builder: "_Builder"):
+        self.b = builder
+        self.sources: List[int] = []
+        self._entries: List[int] = []
+        self._targets: List["_Target"] = []
+
+    def add_source(self, nid: int) -> None:
+        self.sources.append(nid)
+        for e in self._entries:
+            self.b.exc[nid].add(e)
+        for t in self._targets:
+            t.add_source(nid)
+
+    def add_entry(self, nid: int) -> None:
+        self._entries.append(nid)
+        for s in self.sources:
+            self.b.exc[s].add(nid)
+
+    def add_target(self, t: "_Target") -> None:
+        self._targets.append(t)
+        for s in self.sources:
+            t.add_source(s)
+
+
+def _match_none_test(test: ast.AST):
+    """``if X is None`` / ``if not X`` → ('X', True): X is None/empty on
+    the true branch; ``if X is not None`` / ``if X`` → ('X', False)."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name) \
+            and len(test.comparators) == 1 \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return test.left.id, True
+        if isinstance(test.ops[0], ast.IsNot):
+            return test.left.id, False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name):
+        return test.operand.id, True
+    if isinstance(test, ast.Name):
+        return test.id, False
+    return None
+
+
+class CFG:
+    """nodes[i] is the statement for node id ``i``; ``succ``/``exc`` map
+    node id → successor ids (:data:`EXIT` included).  ``edge_null``
+    marks normal edges on which a name is statically known to be
+    None/empty (``if x is None: ...``) — path-sensitive facts the
+    lifetime dataflow subtracts per-edge."""
+
+    def __init__(self, nodes: List[ast.stmt], succ: Dict[int, Set[int]],
+                 exc: Dict[int, Set[int]], entry: int,
+                 edge_null: Dict[tuple, str]):
+        self.nodes = nodes
+        self.succ = succ
+        self.exc = exc
+        self.entry = entry
+        self.edge_null = edge_null
+
+    def preds(self):
+        """(normal_preds, exc_preds): node id → set of predecessor ids."""
+        np: Dict[int, Set[int]] = {}
+        ep: Dict[int, Set[int]] = {}
+        for src, dsts in self.succ.items():
+            for d in dsts:
+                np.setdefault(d, set()).add(src)
+        for src, dsts in self.exc.items():
+            for d in dsts:
+                ep.setdefault(d, set()).add(src)
+        return np, ep
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[ast.stmt] = []
+        self.succ: Dict[int, Set[int]] = {}
+        self.exc: Dict[int, Set[int]] = {}
+        self.edge_null: Dict[tuple, str] = {}
+        # fallthrough null facts resolved after all edges are wired:
+        # (header id, name, exempt body-entry id or None)
+        self.pending_null: List[tuple] = []
+        # each loop frame: (header id, [break node ids])
+        self.loops: List[list] = []
+
+    def new(self, stmt: ast.stmt) -> int:
+        nid = len(self.nodes)
+        self.nodes.append(stmt)
+        self.succ[nid] = set()
+        self.exc[nid] = set()
+        return nid
+
+    def wire(self, frontier: Set[int], nid: int) -> None:
+        for f in frontier:
+            self.succ[f].add(nid)
+
+    # -- statement dispatch --------------------------------------------------
+    def block(self, stmts, frontier: Set[int], target: _Target) -> Set[int]:
+        for s in stmts:
+            frontier = self.stmt(s, frontier, target)
+        return frontier
+
+    def stmt(self, s: ast.stmt, frontier: Set[int],
+             target: _Target) -> Set[int]:
+        if isinstance(s, ast.Try):
+            return self._try(s, frontier, target)
+        if isinstance(s, ast.If):
+            return self._if(s, frontier, target)
+        if isinstance(s, (ast.While,)):
+            return self._while(s, frontier, target)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return self._for(s, frontier, target)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self._with(s, frontier, target)
+        if isinstance(s, ast.Match):
+            return self._match(s, frontier, target)
+
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        if isinstance(s, ast.Return):
+            self.succ[nid].add(EXIT)
+            return set()
+        if isinstance(s, ast.Raise):
+            return set()                # exc edge is the only way out
+        if isinstance(s, ast.Break):
+            if self.loops:
+                self.loops[-1][1].append(nid)
+            return set()
+        if isinstance(s, ast.Continue):
+            if self.loops:
+                self.succ[nid].add(self.loops[-1][0])
+            return set()
+        return {nid}
+
+    # -- compound statements -------------------------------------------------
+    def _if(self, s, frontier, target):
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        nt = _match_none_test(s.test)
+        body_first = len(self.nodes)
+        then = self.block(s.body, {nid}, target)
+        body_entry = body_first if len(self.nodes) > body_first else None
+        if nt is not None:
+            name, on_true = nt
+            if on_true and body_entry is not None:
+                self.edge_null[(nid, body_entry)] = name
+            elif not on_true:
+                if s.orelse:
+                    orelse_first = len(self.nodes)
+                    els = self.block(s.orelse, {nid}, target)
+                    if len(self.nodes) > orelse_first:
+                        self.edge_null[(nid, orelse_first)] = name
+                    return then | els
+                self.pending_null.append((nid, name, body_entry))
+        if s.orelse:
+            els = self.block(s.orelse, {nid}, target)
+        else:
+            els = {nid}
+        return then | els
+
+    def _while(self, s, frontier, target):
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        self.loops.append([nid, []])
+        body = self.block(s.body, {nid}, target)
+        self.wire(body, nid)            # back edge
+        _, breaks = self.loops.pop()
+        infinite = (isinstance(s.test, ast.Constant)
+                    and bool(s.test.value) is True)
+        out = set(breaks) if infinite else {nid} | set(breaks)
+        if s.orelse:
+            out = self.block(s.orelse, out, target) | set(breaks)
+        return out
+
+    def _for(self, s, frontier, target):
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        self.loops.append([nid, []])
+        body = self.block(s.body, {nid}, target)
+        self.wire(body, nid)            # back edge
+        _, breaks = self.loops.pop()
+        out = {nid} | set(breaks)
+        if s.orelse:
+            out = self.block(s.orelse, out, target) | set(breaks)
+        return out
+
+    def _with(self, s, frontier, target):
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        return self.block(s.body, {nid}, target)
+
+    def _match(self, s, frontier, target):
+        nid = self.new(s)
+        self.wire(frontier, nid)
+        if stmt_may_raise(s):
+            target.add_source(nid)
+        out: Set[int] = {nid}           # no case may match
+        for case in s.cases:
+            out |= self.block(case.body, {nid}, target)
+        return out
+
+    def _try(self, s, frontier, target):
+        catch_all = any(_is_catch_all(h) for h in s.handlers)
+        body_t = _Target(self)
+        # exceptions escaping the handlers / orelse / propagating past a
+        # non-catch-all handler set route through the finally body (when
+        # present) and then outward.
+        after_t = _Target(self)
+        body_out = self.block(s.body, frontier, body_t)
+        if s.orelse:
+            body_out = self.block(s.orelse, body_out, after_t)
+
+        handler_outs: Set[int] = set()
+        for h in s.handlers:
+            entry_frontier: Set[int] = set()
+            first_len = len(self.nodes)
+            h_out = self.block(h.body, entry_frontier, after_t)
+            if len(self.nodes) > first_len:
+                body_t.add_entry(first_len)
+            handler_outs |= h_out
+        if not s.handlers or not catch_all:
+            body_t.add_target(after_t)
+
+        out = body_out | handler_outs
+        if s.finalbody:
+            fin_t = _Target(self)       # raises inside finally: outward
+            fin_t.add_target(target)
+            first_len = len(self.nodes)
+            fin_out = self.block(s.finalbody, out, fin_t)
+            if len(self.nodes) > first_len:
+                after_t.add_entry(first_len)
+                # pending-exception continuation: finally exit → outer
+                for f in fin_out:
+                    target.add_source(f)
+            else:
+                after_t.add_target(target)
+            return fin_out
+        after_t.add_target(target)
+        return out
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG for one ``FunctionDef`` / ``AsyncFunctionDef``."""
+    b = _Builder()
+    top = _Target(b)
+    frontier = b.block(fn.body, set(), top)
+    for f in frontier:
+        b.succ[f].add(EXIT)             # fall off the end
+    top.add_entry(EXIT)
+    for nid, name, body_entry in b.pending_null:
+        for t in b.succ[nid]:
+            if t != body_entry:
+                b.edge_null[(nid, t)] = name
+    entry = 0 if b.nodes else EXIT
+    return CFG(b.nodes, b.succ, b.exc, entry, b.edge_null)

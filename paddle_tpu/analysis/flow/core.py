@@ -1,0 +1,184 @@
+"""The flow-tier analyzer: contexts → call graph → registry
+resolution → CFG dataflow passes → :class:`~paddle_tpu.analysis.core.Report`.
+
+Operational discipline matches the concurrency tier exactly:
+
+* an empty resource registry is an **error** (exit 2) — a lifetime
+  audit with no declared resources checks nothing;
+* a registry entry whose module IS scanned but whose class/def/closure
+  no longer exists is **drift** (error): move the registry line in the
+  same PR that moved the code;
+* entries for unscanned modules are skipped silently so targeted runs
+  stay useful — but if the registry matches *nothing at all* in the
+  scanned paths, that is again an error, never a silent green;
+* baseline entries are shared with ``tools/tpu_lint_baseline.txt`` and
+  scoped per-tier: this analyzer loads only TPU7xx entries.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..baseline import Baseline
+from ..core import FileContext, Finding, Report, _iter_py_files, \
+    fold_findings
+from ..concurrency.graph import CallGraph
+from .resources import DEFAULT_REGISTRY, ResourceRegistry
+from .rules import FlowContext
+
+__all__ = ["FlowAnalyzer"]
+
+
+def _drift(errors: List[str], label: str, spec: str, what: str):
+    errors.append(
+        f"flow registry drift: {label} entry '{spec}' {what} in the "
+        f"scanned tree — update analysis/flow/resources.py in the same "
+        f"change that moved it")
+
+
+class FlowAnalyzer:
+    """Run the TPU7xx passes over a file tree."""
+
+    def __init__(self, root: Optional[str] = None, passes=None,
+                 baseline_path: Optional[str] = "auto",
+                 registry: Optional[ResourceRegistry] = None):
+        from . import FLOW_PASSES
+        self.root = os.path.abspath(root or os.getcwd())
+        self.passes = [p() if isinstance(p, type) else p
+                       for p in (passes if passes is not None
+                                 else FLOW_PASSES)]
+        self.registry = registry if registry is not None else \
+            DEFAULT_REGISTRY
+        if baseline_path == "auto":
+            baseline_path = os.path.join(self.root, "tools",
+                                         "tpu_lint_baseline.txt")
+            if not os.path.exists(baseline_path):
+                baseline_path = None
+        base = Baseline.load(baseline_path) if baseline_path \
+            else Baseline([])
+        # only this tier's entries — the other tiers' runs own the rest
+        self.baseline = base.subset(lambda e: e.rule.startswith("TPU7"))
+
+    # -- registry resolution -------------------------------------------------
+    def _resolve_entries(self, graph: CallGraph, errors: List[str]):
+        """jit_entries → (module, class) → watched attr set, with drift
+        checks (class must exist and assign the attr somewhere)."""
+        out: Dict[Tuple[str, str], Set[str]] = {}
+        for spec in self.registry.jit_entries:
+            mod, rest = spec.split(":", 1)
+            if mod not in graph.modules:
+                continue
+            cls, attr = rest.rsplit(".", 1)
+            members = [i for i in graph.fns.values()
+                       if i.module == mod and i.cls == cls]
+            if not members:
+                _drift(errors, "jit_entries", spec,
+                       f"names class '{cls}' which no longer exists")
+                continue
+            assigned = any(
+                isinstance(n, ast.Assign)
+                and any(isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self" and t.attr == attr
+                        for t in n.targets)
+                for i in members for n in ast.walk(i.node))
+            if not assigned:
+                _drift(errors, "jit_entries", spec,
+                       f"names attribute '{attr}' that no method of "
+                       f"'{cls}' assigns")
+                continue
+            out.setdefault((mod, cls), set()).add(attr)
+        return out
+
+    def _resolve_closures(self, graph: CallGraph, errors: List[str]):
+        out = []
+        for spec in self.registry.jit_closures:
+            mod, rest = spec.split(":", 1)
+            if mod not in graph.modules:
+                continue
+            owner_q, clo_name = rest.rsplit(".", 1)
+            owner = graph.fns.get(f"{mod}:{owner_q}")
+            if owner is None:
+                _drift(errors, "jit_closures", spec,
+                       f"names '{owner_q}' which matches no definition")
+                continue
+            clo = next(
+                (n for n in ast.walk(owner.node)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+                 and n is not owner.node and n.name == clo_name),
+                None)
+            if clo is None:
+                _drift(errors, "jit_closures", spec,
+                       f"names closure '{clo_name}' not defined inside "
+                       f"'{owner_q}'")
+                continue
+            out.append((owner, clo))
+        return out
+
+    def _check_delegates(self, graph: CallGraph, errors: List[str]):
+        for spec_obj in self.registry.mirrors:
+            for spec in spec_obj.delegates:
+                mod = spec.split(":", 1)[0]
+                if mod not in graph.modules:
+                    continue
+                if graph.fns.get(spec) is None:
+                    _drift(errors,
+                           f"mirror '{spec_obj.name}' delegates", spec,
+                           "matches no definition")
+
+    # -- run -----------------------------------------------------------------
+    def run(self, paths: Optional[Sequence[str]] = None) -> Report:
+        paths = list(paths) if paths else ["paddle_tpu"]
+        report = Report([], [], [], [], [])
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if not os.path.exists(ap):
+                report.errors.append(f"{p}: path does not exist")
+        if self.registry.empty():
+            report.errors.append(
+                "flow resource registry is empty — a lifetime audit "
+                "with no declared resources checks nothing; refusing a "
+                "silent green")
+            return report
+
+        contexts: List[FileContext] = []
+        for path in _iter_py_files(paths, self.root):
+            try:
+                contexts.append(FileContext(path, self.root))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                report.errors.append(f"{path}: {e}")
+        report.files = len(contexts)
+
+        graph = CallGraph(contexts)
+        lifetime_fns = [i for i in graph.fns.values()
+                        if i.module in self.registry.modules]
+        entry_attrs = self._resolve_entries(graph, report.errors)
+        closures = self._resolve_closures(graph, report.errors)
+        self._check_delegates(graph, report.errors)
+        mirror_fns = any(
+            i.module in spec.modules
+            for spec in self.registry.mirrors
+            for i in graph.fns.values())
+        if contexts and not (lifetime_fns or entry_attrs or closures
+                             or mirror_fns):
+            report.errors.append(
+                "flow registry matched zero analyzable functions in "
+                "the scanned paths — scan the package root or fix the "
+                "registry; refusing a silent green")
+
+        fc = FlowContext(graph=graph, registry=self.registry,
+                         lifetime_fns=lifetime_fns,
+                         entry_attrs=entry_attrs, closures=closures)
+
+        raw: List[Finding] = []
+        seen = set()
+        for pz in self.passes:
+            for f in pz.check(fc):
+                if f not in seen:       # Finding is frozen/hashable
+                    seen.add(f)
+                    raw.append(f)
+        raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        fold_findings(report, raw, contexts, self.baseline)
+        return report

@@ -1,0 +1,253 @@
+"""The flow tier's declarative resource / pairing registry.
+
+Same contract as the concurrency tier's ``roles.py``: every entry is a
+**declaration with a mandatory reason string** — the reason is the
+review artifact, and an empty registry is an exit-2 error, never a
+silent green.  Three rule families consume it:
+
+* **TPU701** (page-lifetime balance) reads ``modules`` /``acquires`` /
+  ``releases`` / ``transfers``: within the declared serving modules,
+  every value returned by an *acquire* call must, on every CFG path
+  leaving the function — including exception edges — reach a *release*
+  call, a *transfer* into a tracked owner structure (assignment into an
+  attribute/subscript, a declared transfer call, or being returned),
+  or a compensating handler that does the same.
+
+  The acquire/release/transfer sets are **caller-side** vocabulary:
+  ``adopt_page`` appears under *transfers* because the caller hands the
+  page over to the allocator's cached pool (from the allocator's own
+  point of view it is an acquisition — that side is its internal
+  bookkeeping, checked by its own function's dataflow).
+
+* **TPU702** (retrace hazard) reads ``jit_entries`` / ``jit_closures``
+  / ``bounded_sources`` / ``array_wrappers`` / ``ctor_methods``: the
+  statically-declared complement of the runtime recompile watchdog.
+
+* **TPU703** (mirror coherence) reads ``mirrors``: pairs of host-side
+  mirror writes and the device-side ops they must co-occur with, plus
+  the explicitly-delegated reconciliation functions.
+
+Registry drift (a declared class/function that no longer resolves in a
+scanned module) is an exit-2 error: rename the code and the registry in
+the same PR.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+__all__ = ["MirrorSpec", "ResourceRegistry", "DEFAULT_REGISTRY"]
+
+_SCHED = "paddle_tpu.serving.scheduler"
+_ENGINE = "paddle_tpu.serving.engine"
+_PAGES = "paddle_tpu.serving.pages"
+_DISAGG = "paddle_tpu.serving.disagg"
+_KVTIER = "paddle_tpu.serving.kv_tier"
+
+
+@dataclass(frozen=True)
+class MirrorSpec:
+    """One host↔device mirror pair for TPU703.
+
+    A function in one of ``modules`` that writes any ``host_attrs``
+    attribute (plain store, augmented store, or element store through
+    it) must, in the same body, either call one of ``device_calls`` or
+    write one of ``device_attrs`` — unless it is listed in
+    ``ctor_methods`` (initialisation, not mutation) or ``delegates``
+    (the device-side op happened elsewhere, reason required).
+    """
+    name: str
+    modules: Dict[str, str]
+    host_attrs: Tuple[str, ...]
+    device_calls: Dict[str, str]
+    device_attrs: Dict[str, str] = field(default_factory=dict)
+    ctor_methods: Dict[str, str] = field(default_factory=dict)
+    delegates: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ResourceRegistry:
+    # -- TPU701 --------------------------------------------------------------
+    #: module → why its functions are subject to page-lifetime dataflow
+    modules: Dict[str, str] = field(default_factory=dict)
+    #: call name → why its return value is an owned page / page list
+    acquires: Dict[str, str] = field(default_factory=dict)
+    #: call name → why passing a handle to it ends the obligation
+    releases: Dict[str, str] = field(default_factory=dict)
+    #: call name → which tracked owner structure the handle moves into
+    transfers: Dict[str, str] = field(default_factory=dict)
+    # -- TPU702 --------------------------------------------------------------
+    #: "module:Class.attr" of a watchdog-watched jitted entry → reason
+    jit_entries: Dict[str, str] = field(default_factory=dict)
+    #: "module:Class.method.closure" of a jitted closure body → reason
+    jit_closures: Dict[str, str] = field(default_factory=dict)
+    #: call name whose result is bounded (bucketing/clamping) → reason
+    bounded_sources: Dict[str, str] = field(default_factory=dict)
+    #: call name that produces an array (traced, not a cache key) → reason
+    array_wrappers: Dict[str, str] = field(default_factory=dict)
+    #: method name treated as construction (writes there are init) → reason
+    ctor_methods: Dict[str, str] = field(default_factory=dict)
+    # -- TPU703 --------------------------------------------------------------
+    mirrors: Tuple[MirrorSpec, ...] = ()
+
+    def empty(self) -> bool:
+        return not (self.modules or self.acquires or self.jit_entries
+                    or self.mirrors)
+
+
+#: the production registry for the serving stack.
+DEFAULT_REGISTRY = ResourceRegistry(
+    modules={
+        _SCHED: "owns admission/preempt/fetch state machines that "
+                "allocate pages on behalf of the engine",
+        _ENGINE: "owns the paged KV cache and every COW/import path",
+        _PAGES: "the allocator itself: internal free-list moves must "
+                "balance too",
+        _DISAGG: "prefill→decode handoff allocates on the decode side "
+                 "across a network boundary",
+        _KVTIER: "host tier stages page payloads against a byte budget",
+    },
+    acquires={
+        "alloc": "PageAllocator.alloc pops a free page the caller now "
+                 "owns until mapped/adopted/released",
+        "_fetch_alloc": "scheduler helper: returns a list of owned "
+                        "pages for a host-tier fetch (or None)",
+    },
+    releases={
+        "_release": "refcount decrement returns the page to the free "
+                    "list at zero",
+        "free_slot": "releases every page mapped in the slot row",
+        "evict_cached": "drops a cached (refcount-0) page to the free "
+                        "list",
+    },
+    transfers={
+        "map": "page becomes owned by the slot table row",
+        "share": "prefix page mapped with a refcount bump — table-owned",
+        "remap": "COW replacement: new page enters the table, old ref "
+                 "dropped inside",
+        "adopt_page": "page moves into the allocator's cached pool "
+                      "(hash-indexed, evictable)",
+    },
+    jit_entries={
+        f"{_ENGINE}:DecodeEngine._decode":
+            "watch('serving.decode') — the per-token hot path",
+        f"{_ENGINE}:DecodeEngine._verify":
+            "watch('serving.spec_verify') — speculative verify batch",
+        f"{_ENGINE}:DecodeEngine._prefill":
+            "watch('serving.prefill', expected=len(buckets)) — slotted "
+            "prefill, bucketed",
+        f"{_ENGINE}:DecodeEngine._prefill_chunk":
+            "watch('serving.prefill_chunk') — paged chunked prefill",
+        f"{_ENGINE}:DecodeEngine._cow":
+            "watch('serving.cow_copy') — copy-on-write page clone",
+        f"{_ENGINE}:DecodeEngine._kv_export":
+            "watch('serving.kv_export') — page payload gather",
+        f"{_ENGINE}:DecodeEngine._kv_import":
+            "watch('serving.kv_import') — page payload scatter",
+    },
+    jit_closures={
+        f"{_ENGINE}:DecodeEngine._init_paged.decode_fn":
+            "body of serving.decode: must close only over "
+            "shape-constant config, never rebindable state",
+        f"{_ENGINE}:DecodeEngine._init_paged.verify_fn":
+            "body of serving.spec_verify",
+        f"{_ENGINE}:DecodeEngine._init_paged.prefill_chunk_fn":
+            "body of serving.prefill_chunk",
+        f"{_ENGINE}:DecodeEngine._init_paged.cow_copy_fn":
+            "body of serving.cow_copy",
+        f"{_ENGINE}:DecodeEngine._init_paged.kv_export_fn":
+            "body of serving.kv_export",
+        f"{_ENGINE}:DecodeEngine._init_paged.kv_import_fn":
+            "body of serving.kv_import",
+        f"{_ENGINE}:DecodeEngine._init_slotted.decode_fn":
+            "body of the slotted serving.decode",
+        f"{_ENGINE}:DecodeEngine._init_slotted.prefill_fn":
+            "body of the slotted serving.prefill",
+    },
+    bounded_sources={
+        "bucket_for": "pads a length up to the declared bucket ladder — "
+                      "finitely many traced shapes",
+        "min": "clamped above by the other operand",
+    },
+    array_wrappers={
+        "int32": "np/jnp scalar array: traced operand, not a python "
+                 "cache key",
+        "asarray": "array operand",
+        "array": "array operand",
+        "zeros": "array operand",
+        "full": "array operand",
+    },
+    ctor_methods={
+        "__init__": "construction",
+        "__new__": "construction",
+        "_init_paged": "called from __init__ only: builds the paged "
+                       "cache + jit entries",
+        "_init_slotted": "called from __init__ only: slotted layout",
+        "reset": "whole-engine reinitialisation to the "
+                 "post-construction state (serving loop is stopped)",
+    },
+    mirrors=(
+        MirrorSpec(
+            name="slot-length",
+            modules={
+                _SCHED: "act.cache_len mirrors device lengths per slot",
+                _ENGINE: "_len_host mirrors the device lengths array",
+                _DISAGG: "handoff finish must set both sides",
+            },
+            host_attrs=("cache_len", "_len_host"),
+            device_calls={
+                "_set_length": "writes _len_host AND rebuilds the "
+                               "device lengths in one place",
+                "PagedKVCache": "rebuilding the cache pytree IS the "
+                                "device-side lengths write",
+                "_decode": "decode program advances device lengths "
+                           "in-dispatch",
+                "_verify": "verify program advances device lengths "
+                           "in-dispatch",
+                "_prefill": "slotted prefill writes device lengths",
+                "_prefill_chunk": "chunk program writes device lengths",
+                "prefill": "engine.prefill sets device length for the "
+                           "admitted slot",
+                "prefill_step": "paged chunked prefill advances device "
+                                "length",
+                "_run_prefill_chunk": "scheduler wrapper that dispatches "
+                                      "engine.prefill_step",
+                "free_slot": "slot teardown zeroes both sides",
+            },
+            ctor_methods={
+                "__init__": "construction",
+                "_init_paged": "construction helper",
+                "_init_slotted": "construction helper",
+                "reset": "reinitialisation with the loop stopped",
+            },
+            delegates={
+                f"{_SCHED}:ContinuousBatchingScheduler._consume_inflight":
+                    "mirrors the finalize of an ALREADY-dispatched "
+                    "decode/verify program at its one allowlisted "
+                    "fetch point (TPU602) — the device advance "
+                    "happened at submit",
+                f"{_ENGINE}:DecodeEngine.decode_spec_fetch":
+                    "reconciles _len_host with the verify program's "
+                    "per-slot accept counts after the fetch — device "
+                    "side advanced at decode_spec_submit",
+            },
+        ),
+        MirrorSpec(
+            name="device-page-table",
+            modules={
+                _PAGES: "table mutations must invalidate the memoised "
+                        "device copy or stale mappings reach the kernel",
+            },
+            host_attrs=("table",),
+            device_calls={},
+            device_attrs={
+                "_device_table": "None-ing the memo forces re-upload on "
+                                 "next device_table()",
+            },
+            ctor_methods={
+                "__init__": "construction",
+                "reset": "rebuilds table and memo together",
+            },
+        ),
+    ),
+)

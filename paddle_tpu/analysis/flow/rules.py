@@ -1,0 +1,496 @@
+"""The TPU7xx flow passes: page lifetime, retrace hazard, mirror
+coherence.
+
+All three are **intraprocedural** over the per-function exception-edge
+CFG (:mod:`.cfg`) plus the concurrency tier's call graph for scoping
+and class-write tables, and all three are driven exclusively by the
+declared vocabulary in :mod:`.resources` — no heuristics about names
+not in the registry.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding
+from ..concurrency.graph import CallGraph, FnInfo
+from .cfg import EXIT, build_cfg
+from .resources import MirrorSpec, ResourceRegistry
+
+__all__ = ["FlowContext", "FlowPass", "PageLifetimePass",
+           "RetraceHazardPass", "MirrorCoherencePass"]
+
+
+@dataclass
+class FlowContext:
+    """Everything the passes need, resolved once by the analyzer."""
+    graph: CallGraph
+    registry: ResourceRegistry
+    #: functions in the TPU701-scoped modules
+    lifetime_fns: List[FnInfo] = field(default_factory=list)
+    #: (module, class) → set of watched jit-entry attribute names
+    entry_attrs: Dict[Tuple[str, str], Set[str]] = field(
+        default_factory=dict)
+    #: resolved jitted closures: (owning FnInfo, closure def node)
+    closures: List[Tuple[FnInfo, ast.FunctionDef]] = field(
+        default_factory=list)
+
+
+class FlowPass:
+    rule = "TPU700"
+    name = "base"
+    description = ""
+
+    def check(self, fc: FlowContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _walk_shallow(node: ast.AST):
+    """Walk a subtree, not descending into nested def/class/lambda."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    nodes = [expr, *_walk_shallow(expr)]
+    return {n.id for n in nodes if isinstance(n, ast.Name)}
+
+
+def _target_names(t: ast.AST) -> Set[str]:
+    """Plain Name targets of an assignment target (tuples unpacked)."""
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in t.elts:
+            out |= _target_names(e)
+        return out
+    return set()
+
+
+# ---------------------------------------------------------------------------
+# TPU701 — page-lifetime balance
+# ---------------------------------------------------------------------------
+
+class _StmtFacts:
+    """gen/kill + immediately-dropped acquisitions for one CFG node."""
+
+    __slots__ = ("gen", "kill", "dropped")
+
+    def __init__(self):
+        self.gen: Set[str] = set()
+        self.kill: Set[str] = set()
+        self.dropped: List[Tuple[ast.Call, str]] = []
+
+
+def _header_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a compound statement's CFG node evaluates (its
+    bodies are separate nodes and must not be double-counted here)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def _stmt_facts(stmt: ast.stmt, reg: ResourceRegistry) -> _StmtFacts:
+    facts = _StmtFacts()
+    consuming = set(reg.releases) | set(reg.transfers)
+    roots = _header_exprs(stmt)
+
+    # parent map over the node's own expressions
+    parents: Dict[ast.AST, ast.AST] = {}
+    for root in roots:
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                    continue
+                parents[c] = n
+                stack.append(c)
+
+    def enclosing_consumer(call: ast.Call) -> bool:
+        p = parents.get(call)
+        while p is not None:
+            if isinstance(p, ast.Call) and _call_name(p) in consuming:
+                return True
+            p = parents.get(p)
+        return False
+
+    # acquisitions → gen / inline-consumed / dropped
+    for root in roots:
+        nodes = [root] + [n for n in _walk_shallow(root)]
+        for n in nodes:
+            if not (isinstance(n, ast.Call)
+                    and _call_name(n) in reg.acquires):
+                continue
+            if enclosing_consumer(n):
+                continue
+            bound = False
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                    and getattr(stmt, "value", None) is n:
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        facts.gen.add(t.id)
+                    # attribute/subscript/tuple target: owned elsewhere
+                bound = True
+            elif isinstance(stmt, ast.Return):
+                bound = True            # ownership moves to the caller
+            else:
+                p = parents.get(n)
+                if isinstance(p, ast.Call) \
+                        and isinstance(p.func, ast.Attribute) \
+                        and p.func.attr == "append" \
+                        and isinstance(p.func.value, ast.Name) \
+                        and n in p.args:
+                    facts.gen.add(p.func.value.id)
+                    bound = True
+            if not bound:
+                facts.dropped.append((n, _call_name(n)))
+
+    # kills
+    for root in roots:
+        for n in _walk_shallow(root):
+            if isinstance(n, ast.Call) and _call_name(n) in consuming:
+                for a in n.args:
+                    if isinstance(a, ast.Name):
+                        facts.kill.add(a.id)
+                    elif isinstance(a, ast.Starred) \
+                            and isinstance(a.value, ast.Name):
+                        facts.kill.add(a.value.id)
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                facts.kill.add(t.id)    # rebinding ends the obligation
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                # stored into an owner structure
+                facts.kill |= _names_in(stmt.value)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                facts.kill |= _target_names(t)
+    elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                        ast.Name):
+        facts.kill.add(stmt.target.id)
+    elif isinstance(stmt, ast.Return) and stmt.value is not None:
+        facts.kill |= _names_in(stmt.value)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            facts.kill |= _target_names(t)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        # `for pid in pids: release(pid)` — compensating drain loops:
+        # consuming the loop variable consumes the iterable.
+        loop_targets = _target_names(stmt.target)
+        for n in _walk_shallow(stmt):
+            if isinstance(n, ast.Call) and _call_name(n) in consuming:
+                args = {a.id for a in n.args if isinstance(a, ast.Name)}
+                if args & loop_targets:
+                    facts.kill |= _names_in(stmt.iter)
+                    break
+    return facts
+
+
+class PageLifetimePass(FlowPass):
+    rule = "TPU701"
+    name = "page-lifetime"
+    description = ("acquired page handle must reach a release/transfer "
+                   "on every path out of the function, raise edges "
+                   "included")
+
+    def check(self, fc: FlowContext) -> Iterable[Finding]:
+        for info in fc.lifetime_fns:
+            yield from self._check_fn(info, fc.registry)
+
+    def _check_fn(self, info: FnInfo, reg: ResourceRegistry):
+        cfg = build_cfg(info.node)
+        n = len(cfg.nodes)
+        facts = [_stmt_facts(cfg.nodes[i], reg) for i in range(n)]
+
+        for i in range(n):
+            for call, cname in facts[i].dropped:
+                yield info.ctx.finding(
+                    self.rule, call,
+                    f"result of acquire call '{cname}()' is dropped — "
+                    f"the page handle can never be released; bind it, "
+                    f"or wrap it in a declared transfer",
+                    info.qualname)
+
+        # forward may-hold fixpoint
+        IN: List[Set[str]] = [set() for _ in range(n)]
+        work = list(range(n))
+        while work:
+            i = work.pop()
+            out = (IN[i] - facts[i].kill) | facts[i].gen
+            exc_state = IN[i] - facts[i].kill
+            for s in cfg.succ[i]:
+                if s == EXIT:
+                    continue
+                edge_out = out - {cfg.edge_null.get((i, s))}
+                if not edge_out <= IN[s]:
+                    IN[s] |= edge_out
+                    work.append(s)
+            for s in cfg.exc[i]:
+                if s != EXIT and not exc_state <= IN[s]:
+                    IN[s] |= exc_state
+                    work.append(s)
+
+        # exit-edge audit: earliest origin line per (name, edge kind)
+        leaks: Dict[Tuple[str, str], int] = {}
+        for i in range(n):
+            out = (IN[i] - facts[i].kill) | facts[i].gen
+            exc_state = IN[i] - facts[i].kill
+            if EXIT in cfg.succ[i]:
+                for name in out - {cfg.edge_null.get((i, EXIT))}:
+                    key = (name, "return")
+                    if key not in leaks or leaks[key] > i:
+                        leaks[key] = i
+            if EXIT in cfg.exc[i]:
+                for name in exc_state:
+                    key = (name, "raise")
+                    if key not in leaks or leaks[key] > i:
+                        leaks[key] = i
+        for (name, kind), i in sorted(leaks.items(),
+                                      key=lambda kv: (kv[1], kv[0])):
+            node = cfg.nodes[i]
+            if kind == "raise":
+                msg = (f"page handle '{name}' is held across this "
+                       f"potentially-raising statement and leaks if it "
+                       f"raises (no release/transfer on the exception "
+                       f"edge) — add a compensating except/finally "
+                       f"that releases it")
+            else:
+                msg = (f"page handle '{name}' still held when the "
+                       f"function exits here — release it, transfer it "
+                       f"into a tracked owner, or return it")
+            yield info.ctx.finding(self.rule, node, msg, info.qualname)
+
+
+# ---------------------------------------------------------------------------
+# TPU702 — retrace hazard
+# ---------------------------------------------------------------------------
+
+class RetraceHazardPass(FlowPass):
+    rule = "TPU702"
+    name = "retrace-hazard"
+    description = ("watched jit entry called with an unbounded python "
+                   "scalar, or jitted closure over post-construction "
+                   "mutable state — compile-cache growth")
+
+    def check(self, fc: FlowContext) -> Iterable[Finding]:
+        reg = fc.registry
+        # part A: unbounded python scalars at watched call sites
+        for info in fc.graph.fns.values():
+            attrs = fc.entry_attrs.get((info.module, info.cls or ""))
+            if not attrs:
+                continue
+            yield from self._check_sites(info, attrs, reg)
+        # part B: closures over post-construction-mutated self fields
+        writes = self._class_writes(fc)
+        for owner, clo in fc.closures:
+            written = writes.get((owner.module, owner.cls or ""), {})
+            reads = {
+                n.attr for n in _walk_shallow(clo)
+                if isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                and isinstance(n.ctx, ast.Load)}
+            for attr in sorted(reads & set(written)):
+                w_line = written[attr]
+                yield owner.ctx.finding(
+                    self.rule, clo,
+                    f"jitted closure '{clo.name}' reads self.{attr}, "
+                    f"which is rebound post-construction (line "
+                    f"{w_line}) — every rebind silently retraces; "
+                    f"pass it as a traced argument instead",
+                    f"{owner.qualname}.{clo.name}")
+
+    # -- part A helpers ------------------------------------------------------
+    def _check_sites(self, info: FnInfo, attrs: Set[str],
+                     reg: ResourceRegistry):
+        len_tainted: Set[str] = set()
+        for n in _walk_shallow(info.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                v = n.value
+                if isinstance(v, ast.Call) and _call_name(v) in \
+                        reg.bounded_sources:
+                    len_tainted.discard(n.targets[0].id)
+                elif any(isinstance(c, ast.Call)
+                         and _call_name(c) == "len"
+                         for c in ast.walk(v)):
+                    len_tainted.add(n.targets[0].id)
+
+        def visit(node, loop_vars: Set[str]):
+            if node is not info.node \
+                    and isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                inner = loop_vars | _target_names(node.target)
+                for c in ast.iter_child_nodes(node):
+                    visit(c, inner)
+                return
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in attrs \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                for a in node.args:
+                    reason = self._unbounded(a, loop_vars, len_tainted,
+                                             reg)
+                    if reason:
+                        yield_list.append((node, node.func.attr, reason))
+            for c in ast.iter_child_nodes(node):
+                visit(c, loop_vars)
+
+        yield_list: List[Tuple[ast.Call, str, str]] = []
+        visit(info.node, set())
+        for call, attr, reason in yield_list:
+            yield info.ctx.finding(
+                self.rule, call,
+                f"watched jit entry self.{attr}() called with a python "
+                f"scalar whose value source is unbounded ({reason}) — "
+                f"each distinct value compiles a new executable; "
+                f"bucket it or pass an array",
+                info.qualname)
+
+    def _unbounded(self, arg, loop_vars: Set[str],
+                   len_tainted: Set[str], reg: ResourceRegistry):
+        if isinstance(arg, ast.Call):
+            nm = _call_name(arg)
+            if nm in reg.array_wrappers or nm in reg.bounded_sources:
+                return None
+        if isinstance(arg, (ast.Constant, ast.Attribute)):
+            return None
+        for n in [arg] + list(_walk_shallow(arg)):
+            if isinstance(n, ast.Call):
+                nm = _call_name(n)
+                if nm in reg.bounded_sources or nm in reg.array_wrappers:
+                    return None         # bounded somewhere in the expr
+            if isinstance(n, ast.Call) and _call_name(n) == "len":
+                return "len() of a runtime-sized object"
+            if isinstance(n, ast.Name):
+                if n.id in loop_vars:
+                    return f"'{n.id}' is a loop variable"
+                if n.id in len_tainted:
+                    return f"'{n.id}' is assigned from len()"
+        return None
+
+    # -- part B helpers ------------------------------------------------------
+    def _class_writes(self, fc: FlowContext):
+        """(module, class) → {attr: first post-construction rebind line}."""
+        out: Dict[Tuple[str, str], Dict[str, int]] = {}
+        ctors = set(fc.registry.ctor_methods)
+        want = {(owner.module, owner.cls or "")
+                for owner, _ in fc.closures}
+        for info in fc.graph.fns.values():
+            key = (info.module, info.cls or "")
+            if not info.cls or key not in want:
+                continue
+            if info.qualname.rsplit(".", 1)[-1] in ctors:
+                continue
+            table = out.setdefault(key, {})
+            for n in _walk_shallow(info.node):
+                targets = []
+                if isinstance(n, ast.Assign):
+                    targets = n.targets
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [n.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        line = getattr(n, "lineno", 0)
+                        if t.attr not in table or table[t.attr] > line:
+                            table[t.attr] = line
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TPU703 — mirror coherence
+# ---------------------------------------------------------------------------
+
+class MirrorCoherencePass(FlowPass):
+    rule = "TPU703"
+    name = "mirror-coherence"
+    description = ("host-side mirror write must co-occur with its "
+                   "device op in the same function or a declared "
+                   "delegation")
+
+    def check(self, fc: FlowContext) -> Iterable[Finding]:
+        for spec in fc.registry.mirrors:
+            for info in fc.graph.fns.values():
+                if info.module not in spec.modules:
+                    continue
+                mname = info.qualname.rsplit(".", 1)[-1]
+                if mname in spec.ctor_methods:
+                    continue
+                if f"{info.module}:{info.qualname}" in spec.delegates:
+                    continue
+                yield from self._check_fn(info, spec)
+
+    def _check_fn(self, info: FnInfo, spec: MirrorSpec):
+        host_writes: List[Tuple[ast.AST, str]] = []
+        device_ok = False
+        for n in _walk_shallow(info.node):
+            if isinstance(n, ast.Call) \
+                    and _call_name(n) in spec.device_calls:
+                device_ok = True
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            for t in targets:
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute):
+                    if base.attr in spec.host_attrs:
+                        host_writes.append((n, base.attr))
+                    if base.attr in spec.device_attrs:
+                        device_ok = True
+        if not host_writes or device_ok:
+            return
+        pair_with = ", ".join(sorted(set(spec.device_calls)
+                                     | set(spec.device_attrs)))
+        seen_lines = set()
+        for node, attr in host_writes:
+            if node.lineno in seen_lines:
+                continue
+            seen_lines.add(node.lineno)
+            yield info.ctx.finding(
+                self.rule, node,
+                f"host mirror '{attr}' ({spec.name}) written with no "
+                f"paired device op in scope — pair it with one of "
+                f"[{pair_with}] or declare a delegation (with reason) "
+                f"in flow/resources.py",
+                info.qualname)

@@ -1017,18 +1017,26 @@ class DecodeEngine:
         """Copy-on-write ``slot``'s page-table entry ``idx`` to a fresh
         private page (raises PagePoolExhausted when the pool is dry)."""
         new_pid = self._alloc.alloc()
-        old_pid = int(self._alloc.table[int(slot), int(idx)])
-        c = self.cache
-        tr_on = self._tracer.enabled
-        if tr_on:
-            c0 = self._cow.compile_count
-            t0_ns = time.perf_counter_ns()
-        with x64_scope(False), self._trace_scope():
-            k, v, ks, vs = self._cow(c.k, c.v, c.k_scale, c.v_scale,
-                                     jnp.asarray(old_pid, jnp.int32),
-                                     jnp.asarray(new_pid, jnp.int32))
-        if tr_on:
-            self._dispatch_span("engine.cow_copy", self._cow, t0_ns, c0)
+        try:
+            old_pid = int(self._alloc.table[int(slot), int(idx)])
+            c = self.cache
+            tr_on = self._tracer.enabled
+            if tr_on:
+                c0 = self._cow.compile_count
+                t0_ns = time.perf_counter_ns()
+            with x64_scope(False), self._trace_scope():
+                k, v, ks, vs = self._cow(c.k, c.v, c.k_scale, c.v_scale,
+                                         jnp.asarray(old_pid, jnp.int32),
+                                         jnp.asarray(new_pid, jnp.int32))
+            if tr_on:
+                self._dispatch_span("engine.cow_copy", self._cow, t0_ns,
+                                    c0)
+        except Exception:
+            # a torn COW dispatch must not strand the fresh page: the
+            # pool outlives the failed step (the scheduler's tear paths
+            # free the slot and keep serving the other slots)
+            self._alloc._release(new_pid)
+            raise
         self._alloc.remap(int(slot), int(idx), new_pid)
         self.cache = PagedKVCache(k, v, c.page_table, c.lengths,
                                   k_scale=ks, v_scale=vs)
@@ -1763,6 +1771,16 @@ class DecodeEngine:
         from .kv_tier import ClusterPrefixIndex
         self._kv_index = ClusterPrefixIndex(store, host=host,
                                             interval=interval)
+        if self._host_tier is not None:
+            # LRU evictions must leave the published set immediately —
+            # a replica that fetches a just-evicted digest gets a miss
+            # and recomputes, but a stale advertisement lingering until
+            # the next interval publish turns every hit into a miss
+            # storm.  withdraw() only mutates the digest set under the
+            # index's own lock (store I/O stays on the publisher
+            # thread), so this is safe to run from the hook, which the
+            # tier invokes after releasing its lock.
+            self._host_tier.evict_hook = self._kv_index.withdraw
         if start:
             self._kv_index.start()
         return self._kv_index

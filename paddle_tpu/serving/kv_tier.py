@@ -180,6 +180,12 @@ class HostPageTier:
         self._bytes = 0
         self.spilled = 0        # entries admitted (lifetime)
         self.lru_evicted = 0    # entries LRU-evicted over budget
+        # called with the list of LRU-evicted digests AFTER _lock is
+        # released
+        # (the cluster index withdraws it from the TCPStore; store I/O
+        # must never run under a tier lock — the TPU601/TPU604
+        # discipline: a wedged store would wedge every put/get)
+        self.evict_hook = None
 
     @property
     def enabled(self) -> bool:
@@ -199,6 +205,7 @@ class HostPageTier:
         nb = self._entry_bytes(arrays)
         if nb > self.budget_bytes:
             return False
+        evicted = []
         with self._lock:
             old = self._entries.pop(digest, None)
             if old is not None:
@@ -207,9 +214,18 @@ class HostPageTier:
             self._bytes += nb
             self.spilled += 1
             while self._bytes > self.budget_bytes:
-                _d, ev = self._entries.popitem(last=False)
+                d, ev = self._entries.popitem(last=False)
                 self._bytes -= ev["nbytes"]
                 self.lru_evicted += 1
+                evicted.append(d)
+        hook = self.evict_hook
+        if hook is not None and evicted:
+            try:
+                hook(evicted)
+            except Exception:
+                # best-effort: a broken index must not fail the spill
+                # (the interval publisher republishes the truth)
+                pass
         return True
 
     def get(self, digest) -> Optional[Dict[str, np.ndarray]]:

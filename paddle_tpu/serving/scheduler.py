@@ -705,10 +705,20 @@ class ContinuousBatchingScheduler:
         pids = self._fetch_alloc(rid, f, len(digs))
         if pids is None:
             return                 # aborted, or parked for pages
-        eng.import_pages(f.staged, pids)
-        eng._m_pool.set(eng._alloc.pages_used())
+        try:
+            eng.import_pages(f.staged, pids)
+        except Exception as e:
+            # the scatter tore (device dispatch / staging decode): the
+            # fresh pages were never adopted, so release them
+            # refcount-exactly and degrade this fetch to recompute —
+            # same discipline as a phase-1 transport tear
+            for pid in pids:
+                eng._alloc._release(pid)
+            self._fetch_abort(rid, f, digs, e)
+            return
         for pid, d in zip(pids, digs):
             eng._alloc.adopt_page(pid, [d])
+        eng._m_pool.set(eng._alloc.pages_used())
         f.pages_in += len(digs)
         f.pos += len(digs)
         f.staged = None

@@ -1,0 +1,22 @@
+"""Clean twin: balanced lifetimes, bounded jit args, paired mirror
+writes — zero findings under the fixture registry."""
+
+
+class CleanPool:
+    def balanced_adopt(self, alloc, dev):
+        pid = alloc.grab_page()
+        try:
+            dev.scatter(pid)
+        except Exception:
+            alloc.put_page(pid)
+            raise
+        alloc.adopt(pid)
+
+    def inline_consumed(self, alloc):
+        alloc.adopt(alloc.grab_page())
+
+
+class CleanCache:
+    def paired(self, eng, n):
+        self.cache_len = n
+        eng._set_length(n)

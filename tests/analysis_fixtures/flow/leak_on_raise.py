@@ -1,0 +1,49 @@
+"""Seeded TPU701 violations: page-handle lifetime leaks on raise and
+return edges, next to the balanced shapes that must stay silent.  The
+acquire/release/transfer vocabulary is the fixture registry in
+test_flow_analysis.py: grab_page/grab_pages acquire, put_page
+releases, adopt transfers."""
+
+
+class Pool:
+    def leak_on_raise(self, alloc, dev):
+        pid = alloc.grab_page()
+        dev.scatter(pid)                # positive: leaks if this raises
+        alloc.put_page(pid)
+
+    def leak_on_return(self, alloc, cond):
+        pid = alloc.grab_page()
+        if cond:
+            return None                 # positive: pid still held
+        alloc.put_page(pid)
+        return None
+
+    def dropped_acquire(self, alloc):
+        alloc.grab_page()               # positive: result dropped
+
+    def suppressed_drop(self, alloc):
+        alloc.grab_page()               # tpu-lint: disable=TPU701
+
+    def compensated(self, alloc, dev):
+        pid = alloc.grab_page()
+        try:
+            dev.scatter(pid)
+        except Exception:
+            alloc.put_page(pid)
+            raise
+        alloc.adopt(pid)
+
+    def none_guarded(self, alloc):
+        pids = alloc.grab_pages()
+        if pids is None:
+            return None
+        for p in pids:
+            alloc.put_page(p)
+        return None
+
+    def finally_release(self, alloc, dev):
+        pid = alloc.grab_page()
+        try:
+            dev.scatter(pid)
+        finally:
+            alloc.put_page(pid)

@@ -1,0 +1,26 @@
+"""Seeded TPU703 violations: host mirror writes with no paired device
+op in scope, next to the paired / memo-invalidating / delegated shapes
+that must stay silent."""
+
+
+class Cache:
+    def __init__(self):
+        self.cache_len = 0
+        self._device_table = None
+
+    def unpaired_write(self, n):
+        self.cache_len = n              # positive: no device op
+
+    def unpaired_slice(self, s, n):
+        self.cache_len[s] = n           # positive: element store
+
+    def paired_write(self, eng, n):
+        self.cache_len = n
+        eng._set_length(n)
+
+    def memo_invalidating(self, s, n):
+        self.cache_len[s] = n
+        self._device_table = None
+
+    def declared_delegate(self, n):
+        self.cache_len = n

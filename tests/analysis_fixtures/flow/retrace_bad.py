@@ -1,0 +1,32 @@
+"""Seeded TPU702 violations: a watched jit entry fed unbounded python
+scalars, and a jitted closure over post-construction-rebound state.
+The fixture registry watches Engine._step and Engine._build.step_fn;
+bucket_for is the bounded source, asarray the array wrapper."""
+
+
+def jit(fn):
+    return fn
+
+
+class Engine:
+    def __init__(self, cfg):
+        self.page_size = cfg
+        self.table = 0
+        self._step = self._build()
+
+    def _build(self):
+        def step_fn(tokens):            # positive: closes over .table
+            return tokens * self.page_size + self.table
+        return jit(step_fn)
+
+    def drive(self, toks, batch):
+        n = len(batch)
+        self._step(n)                   # positive: len()-derived arg
+        for t in toks:
+            self._step(t)               # positive: loop variable
+        self._step(self.page_size)
+        self._step(bucket_for(n))
+        self._step(asarray(n))
+
+    def retune(self, n):
+        self.table = n

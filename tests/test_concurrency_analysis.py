@@ -249,7 +249,8 @@ def test_list_rules_covers_all_tiers(capsys):
     lines = {ln.split()[0]: ln for ln in out.splitlines() if ln}
     for rule, tier in (("TPU101", "ast"), ("TPU505", "trace"),
                        ("TPU601", "concurrency"),
-                       ("TPU604", "concurrency")):
+                       ("TPU604", "concurrency"),
+                       ("TPU701", "flow"), ("TPU703", "flow")):
         assert rule in lines and tier in lines[rule]
 
 

@@ -1,0 +1,310 @@
+"""tpu-flow (paddle_tpu.analysis.flow) — tier-1 gate.
+
+Same two jobs as the other analysis-tier test files, one tier up:
+(1) pin each TPU7xx pass's detection on seeded fixture violations
+(exact rule id + file:line) under a fixture resource registry, (2) run
+the whole paddle_tpu/ tree strict so any new lifetime/retrace/mirror
+violation fails CI.  Plus the tier contracts: empty/drifted registries
+are errors (never a silent green), the baseline is scoped per-tier in
+both directions, and the leaks fixed in this tier's introduction
+(scheduler._fetch_advance_one phase 3, engine._cow_page) stay fixed.
+"""
+import ast
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (CONCURRENCY_RULES, FLOW_PASSES,
+                                 FLOW_RULES, RULES, TRACE_RULES,
+                                 Analyzer, FlowAnalyzer, MirrorSpec,
+                                 ResourceRegistry)
+from paddle_tpu.analysis.flow.cfg import EXIT, build_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "analysis_fixtures", "flow")
+FIXMOD = "tests.analysis_fixtures.flow"
+
+#: fixture resource vocabulary for tests/analysis_fixtures/flow
+REGISTRY = ResourceRegistry(
+    modules={f"{FIXMOD}.leak_on_raise": "fixture: lifetime module",
+             f"{FIXMOD}.clean": "fixture: clean twin"},
+    acquires={"grab_page": "fixture acquire",
+              "grab_pages": "fixture list acquire"},
+    releases={"put_page": "fixture release"},
+    transfers={"adopt": "fixture transfer"},
+    jit_entries={f"{FIXMOD}.retrace_bad:Engine._step":
+                 "fixture watched entry"},
+    jit_closures={f"{FIXMOD}.retrace_bad:Engine._build.step_fn":
+                  "fixture jitted closure"},
+    bounded_sources={"bucket_for": "fixture bucketing"},
+    array_wrappers={"asarray": "fixture array operand"},
+    ctor_methods={"__init__": "construction"},
+    mirrors=(MirrorSpec(
+        name="fixture-mirror",
+        modules={f"{FIXMOD}.mirror_bad": "fixture: mirror module",
+                 f"{FIXMOD}.clean": "fixture: clean twin"},
+        host_attrs=("cache_len",),
+        device_calls={"_set_length": "fixture device write"},
+        device_attrs={"_device_table": "fixture memo invalidation"},
+        ctor_methods={"__init__": "construction"},
+        delegates={f"{FIXMOD}.mirror_bad:Cache.declared_delegate":
+                   "fixture: declared delegation"},
+    ),),
+)
+
+
+def _fixture_report(baseline_path=None, registry=REGISTRY):
+    an = FlowAnalyzer(root=REPO, baseline_path=baseline_path,
+                      registry=registry)
+    return an.run([FIXDIR])
+
+
+@pytest.fixture(scope="module")
+def tree_report():
+    """One whole-tree strict run shared by the gate + regression tests
+    (a full call-graph + per-function CFG build costs seconds)."""
+    return FlowAnalyzer(root=REPO).run(None)
+
+
+def test_rule_catalogue():
+    assert set(FLOW_RULES) == {"TPU701", "TPU702", "TPU703"}
+    assert len(FLOW_PASSES) == 3
+    # the four tiers stay disjoint
+    assert not set(FLOW_RULES) & set(RULES)
+    assert not set(FLOW_RULES) & set(TRACE_RULES)
+    assert not set(FLOW_RULES) & set(CONCURRENCY_RULES)
+
+
+def test_fixture_matrix():
+    """Each seeded fixture trips exactly its rule at the pinned lines;
+    clean.py (and every balanced/bounded/paired shape in the bad
+    files) trips nothing."""
+    report = _fixture_report()
+    assert not report.errors, report.errors
+    got = sorted((os.path.basename(f.path), f.rule, f.line)
+                 for f in report.findings)
+    assert got == [
+        ("leak_on_raise.py", "TPU701", 11),   # raise-edge leak
+        ("leak_on_raise.py", "TPU701", 17),   # return with handle held
+        ("leak_on_raise.py", "TPU701", 22),   # dropped acquisition
+        ("mirror_bad.py", "TPU703", 12),      # plain unpaired write
+        ("mirror_bad.py", "TPU703", 15),      # unpaired element store
+        ("retrace_bad.py", "TPU702", 18),     # closure over .table
+        ("retrace_bad.py", "TPU702", 24),     # len()-derived scalar
+        ("retrace_bad.py", "TPU702", 26),     # loop-variable scalar
+    ], "\n".join(f.format() for f in report.findings)
+    # symbols carry the qualified owner (closure findings dotted)
+    syms = {f.line: f.symbol for f in report.findings
+            if f.path.endswith("retrace_bad.py")}
+    assert syms[18] == "Engine._build.step_fn"
+    assert syms[24] == "Engine.drive"
+
+
+def test_exception_edge_semantics_are_exact():
+    """The shapes TPU701 must stay silent on, asserted individually so
+    a regression names the broken shape: typed-handler compensation,
+    finally release, inline consumption, and the is-None guard."""
+    report = _fixture_report()
+    flagged = {f.symbol for f in report.findings}
+    for sym in ("Pool.compensated", "Pool.none_guarded",
+                "Pool.finally_release", "CleanPool.balanced_adopt",
+                "CleanPool.inline_consumed"):
+        assert sym not in flagged, sym
+
+
+def test_inline_suppression():
+    report = _fixture_report()
+    sup = [f for f in report.inline_suppressed
+           if f.path.endswith("leak_on_raise.py")]
+    assert len(sup) == 1 and sup[0].rule == "TPU701" and sup[0].line == 25
+    assert not any(f.line == 25 for f in report.findings
+                   if f.path.endswith("leak_on_raise.py"))
+
+
+def test_baseline_suppression(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "TPU701 tests/analysis_fixtures/flow/leak_on_raise.py"
+        "::Pool.leak_on_raise  # fixture: accepted for the baseline test\n"
+        "TPU799 tests/analysis_fixtures/flow/clean.py  # stale\n")
+    report = _fixture_report(baseline_path=str(bl))
+    assert not any(f.symbol == "Pool.leak_on_raise"
+                   for f in report.findings)
+    assert sum(f.rule == "TPU701" for f in report.baselined) == 1
+    assert len(report.stale_baseline) == 1
+    assert "TPU799" in report.stale_baseline[0]
+
+
+def test_per_tier_baseline_isolation(tmp_path):
+    """Neither tier loads (or stale-flags) the other's entries."""
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "TPU101 tests/analysis_fixtures/host_sync_bad.py::_log_scale"
+        "  # ast-tier entry\n"
+        "TPU701 tests/analysis_fixtures/flow/leak_on_raise.py"
+        "::Pool.leak_on_raise  # flow-tier entry\n")
+    flow = _fixture_report(baseline_path=str(bl))
+    assert flow.baselined and all(f.rule == "TPU701"
+                                  for f in flow.baselined)
+    assert flow.stale_baseline == []        # TPU101 entry never loaded
+    ast_rep = Analyzer(root=REPO, baseline_path=str(bl)).run(
+        [os.path.join(REPO, "tests", "analysis_fixtures")])
+    assert any(f.rule == "TPU101" for f in ast_rep.baselined)
+    assert ast_rep.stale_baseline == []     # TPU701 entry never loaded
+
+
+def test_empty_registry_is_an_error():
+    report = _fixture_report(registry=ResourceRegistry())
+    assert not report.ok
+    assert any("registry is empty" in e for e in report.errors)
+
+
+def test_registry_drift_is_an_error():
+    # a jit entry naming a class that no longer exists
+    ghost_cls = ResourceRegistry(jit_entries={
+        f"{FIXMOD}.retrace_bad:Ghost._step": "fixture drift"})
+    report = _fixture_report(registry=ghost_cls)
+    assert not report.ok
+    assert any("drift" in e for e in report.errors)
+    # a jit entry naming an attribute no method assigns
+    ghost_attr = ResourceRegistry(jit_entries={
+        f"{FIXMOD}.retrace_bad:Engine._missing": "fixture drift"})
+    report = _fixture_report(registry=ghost_attr)
+    assert any("drift" in e for e in report.errors)
+    # a closure spec whose owner resolves but closure does not
+    ghost_clo = ResourceRegistry(
+        jit_entries={f"{FIXMOD}.retrace_bad:Engine._step": "valid"},
+        jit_closures={f"{FIXMOD}.retrace_bad:Engine._build.ghost_fn":
+                      "fixture drift"})
+    report = _fixture_report(registry=ghost_clo)
+    assert any("drift" in e for e in report.errors)
+    # a mirror delegate that matches no definition
+    ghost_del = ResourceRegistry(mirrors=(MirrorSpec(
+        name="drifted", modules={f"{FIXMOD}.mirror_bad": "m"},
+        host_attrs=("cache_len",), device_calls={},
+        delegates={f"{FIXMOD}.mirror_bad:Cache.ghost": "gone"}),))
+    report = _fixture_report(registry=ghost_del)
+    assert any("drift" in e for e in report.errors)
+
+
+def test_unscanned_modules_skip_but_zero_matches_fail():
+    # entries for modules outside the scanned paths are silently
+    # skipped when OTHER entries still match…
+    mixed = ResourceRegistry(
+        modules={"paddle_tpu.serving.engine": "unscanned here",
+                 f"{FIXMOD}.leak_on_raise": "fixture module"},
+        acquires={"grab_page": "fixture acquire"},
+        releases={"put_page": "fixture release"})
+    report = _fixture_report(registry=mixed)
+    assert not report.errors, report.errors
+    # …but a registry matching NOTHING in the scanned paths is exit 2,
+    # never a silent green
+    foreign = ResourceRegistry(
+        modules={"paddle_tpu.serving.engine": "unscanned here"},
+        acquires={"alloc": "unreachable"})
+    report = _fixture_report(registry=foreign)
+    assert not report.ok
+    assert any("matched zero" in e for e in report.errors)
+
+
+def test_cfg_exception_edges_unit():
+    """Direct CFG contract: a raising statement gets an exc edge to the
+    enclosing handler, an uncaught one to EXIT, and the is-None guard
+    records its per-edge null fact."""
+    src = textwrap.dedent("""\
+        def f(a):
+            x = get(a)
+            if x is None:
+                return None
+            use(x)
+            try:
+                risky(x)
+            except Exception:
+                cleanup(x)
+            return x
+    """)
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    by_line = {n.lineno: i for i, n in enumerate(cfg.nodes)}
+    # x = get(a) may raise with no handler: exc edge to EXIT
+    assert EXIT in cfg.exc[by_line[2]]
+    # risky(x) raises INTO the handler, not (only) outward
+    assert by_line[9] in cfg.exc[by_line[7]]
+    assert EXIT not in cfg.exc[by_line[7]]       # catch-all handler
+    # the None-guard edge into `return None` carries the null fact
+    assert cfg.edge_null[(by_line[3], by_line[4])] == "x"
+    # return edges land on EXIT via succ, not exc
+    assert EXIT in cfg.succ[by_line[4]]
+
+
+def test_whole_tree_strict_green(tree_report):
+    """THE gate: every TPU7xx finding in paddle_tpu/ is fixed or
+    carries a baselined reason, and the baseline holds no dead
+    weight."""
+    assert tree_report.ok, "new tpu-flow findings:\n" + \
+        "\n".join(f.format() for f in tree_report.findings)
+    assert not tree_report.stale_baseline, \
+        "stale baseline entries:\n" + \
+        "\n".join(tree_report.stale_baseline)
+    assert tree_report.files > 100
+    assert tree_report.baselined, \
+        "baseline expected to cover the documented typed-handler sites"
+
+
+def test_fixed_leaks_stay_fixed(tree_report):
+    """The TPU701 leaks fixed when this tier landed — phase-3 import
+    tear in the fetch state machine and the COW dispatch tear — must
+    stay FIXED: not reappear and not get baselined away."""
+    t701 = [f for f in tree_report.findings + tree_report.baselined
+            if f.rule == "TPU701"]
+    for path, sym in (
+            ("paddle_tpu/serving/scheduler.py",
+             "ContinuousBatchingScheduler._fetch_advance_one"),
+            ("paddle_tpu/serving/engine.py", "DecodeEngine._cow_page")):
+        hits = [f for f in t701 if f.path == path and f.symbol == sym]
+        assert hits == [], "\n".join(f.format() for f in hits)
+
+
+def test_missing_path_is_an_error():
+    report = FlowAnalyzer(root=REPO, baseline_path=None) \
+        .run(["no_such_dir_xyz"])
+    assert not report.ok and report.errors
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--flow", "no_such_dir_xyz", "--root", REPO,
+                 "--strict", "-q", "--baseline", "none"]) == 2
+
+
+def test_cli_error_exit_codes():
+    """The cheap rc-2 discipline cases (no whole-tree build)."""
+    from paddle_tpu.analysis.__main__ import main
+    # the CLI runs the DEFAULT registry: scoping it to the fixture dir
+    # matches zero functions, which must be exit 2, never silent green
+    assert main(["--flow", FIXDIR, "--root", REPO, "--strict",
+                 "-q", "--baseline", "none"]) == 2
+    # tier-scoped --select: rules of another tier are unknown here
+    assert main(["--flow", "--root", REPO, "--select", "TPU101",
+                 "-q"]) == 2
+    # the tiers are separate invocations, any pair is an error
+    assert main(["--flow", "--concurrency", "-q"]) == 2
+    assert main(["--flow", "--trace", "-q"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_whole_tree_strict_green():
+    """The exact CI invocation exits 0 (slow: each call is a full
+    graph + CFG build; runs in the unfiltered CI step)."""
+    from paddle_tpu.analysis.__main__ import main
+    assert main(["--flow", "--root", REPO, "--strict", "-q"]) == 0
+    assert main(["--flow", "--root", REPO, "--strict", "-q",
+                 "--select", "TPU701"]) == 0
+
+
+@pytest.mark.slow
+def test_whole_tree_run_is_deterministic(tree_report):
+    """Two full runs produce byte-identical findings — the CFG build
+    and fixpoint have no dict/set iteration-order dependence."""
+    again = FlowAnalyzer(root=REPO).run(None)
+    fmt = lambda r: [f.format() for f in r.findings + r.baselined]
+    assert fmt(again) == fmt(tree_report)
+    assert again.files == tree_report.files

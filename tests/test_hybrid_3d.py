@@ -63,7 +63,11 @@ import _jax_compat
            "the ISSUE-8 skip sweep: still 0.4.37-red — the strict build "
            "raises the same static-inference error at trace time and the "
            "relaxed build still doubles the 'dp' grads, so neither "
-           "execution path is convertible to a live test on this pin.")
+           "execution path is convertible to a live test on this pin.  "
+           "Re-audited again in the ISSUE-18 (flow tier) sweep: the pin "
+           "is unchanged (jax 0.4.37, `from jax import shard_map` still "
+           "ImportErrors so _OLD_JAX holds) and both failure modes are "
+           "version-determined, so the skip stands verbatim.")
 def test_dp_mp_pp_one_program():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

@@ -310,6 +310,51 @@ def test_chaos_persistent_tear_still_completes(model):
     assert eng._alloc.pages_used() == 0
 
 
+def test_import_dispatch_tear_releases_pages_and_recomputes(model):
+    """A raise out of ``import_pages`` — the fetch's phase-3 device
+    scatter, AFTER the transport staging already verified clean — must
+    release the freshly-allocated destination pages refcount-exactly
+    and degrade the fetch to recompute (tpu-flow TPU701 found this
+    path leaking: the pages were allocated, import raised, and nothing
+    compensated)."""
+    prompt = _prompts(1, seed=21, plen=(40, 41))[0]
+    eng = _engine(model)
+    wave1, _ = _drive(eng, [prompt])
+    assert eng.spill_cached_pages() > 0
+    calls = {"n": 0}
+
+    def torn(bufs, pids):
+        calls["n"] += 1
+        raise RuntimeError("injected import tear")
+
+    eng.import_pages = torn
+    wave2, _ = _drive(eng, [prompt])
+    assert calls["n"] >= 1, "fetch never reached the import phase"
+    assert wave2 == wave1                      # recompute, never wrong
+    assert eng._alloc.pages_used() == 0        # NO stranded dst pages
+    # serviceable afterwards with the real import restored
+    del eng.import_pages
+    wave3, _ = _drive(eng, [prompt])
+    assert wave3 == wave1
+
+
+def test_cow_dispatch_tear_releases_fresh_page(model):
+    """A raise out of the COW copy dispatch must release the freshly
+    allocated private page before re-raising (tpu-flow TPU701 found
+    ``new_pid`` held across the raising ``_cow`` call)."""
+    eng = _engine(model)
+    _drive(eng, _prompts(1, seed=22))
+    used0 = eng._alloc.pages_used()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected cow tear")
+
+    eng._cow = boom
+    with pytest.raises(RuntimeError, match="injected cow tear"):
+        eng._cow_page(0, 0)
+    assert eng._alloc.pages_used() == used0    # fresh page released
+
+
 def test_chaos_site_and_beacon_declared():
     from paddle_tpu.observability.liveness import BEACONS
     assert "serve.kv_tier" in SITES
@@ -444,3 +489,85 @@ def test_engine_attach_cluster_index_offers_and_withdraws(model):
     eng._kv_index.publish_once()
     idx = fetch_index(TCPStore("127.0.0.1", master.port), 1)
     assert idx.get(0, set()) == set()
+
+
+# ---------------------------------------------------------------------------
+# eviction withdraw: store I/O never under a tier lock
+# ---------------------------------------------------------------------------
+
+def test_evict_hook_fires_outside_lock_and_is_best_effort():
+    """LRU eviction invokes ``evict_hook`` with the evicted digests
+    AFTER the tier lock is released, and a raising hook never fails
+    the spill that triggered it."""
+    tier = HostPageTier(budget_bytes=1000)
+    seen = []
+
+    def hook(digests):
+        assert not tier._lock.locked(), "hook ran under the tier lock"
+        seen.append(list(digests))
+        raise RuntimeError("dead index")
+
+    tier.evict_hook = hook
+    assert tier.put("a", _arrays(400))
+    assert tier.put("b", _arrays(400))
+    assert tier.put("c", _arrays(400))         # evicts a; hook raises
+    assert seen == [["a"]]
+    assert "a" not in tier and "c" in tier     # spill still landed
+
+
+def test_attach_cluster_index_wires_evict_hook(model):
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    eng = _engine(model)
+    eng.attach_cluster_index(TCPStore("127.0.0.1", master.port), host=0,
+                             start=False)
+    assert eng._host_tier.evict_hook == eng._kv_index.withdraw
+
+
+class _WedgedStore:
+    """TCPStore proxy whose ``set`` blocks until released — models a
+    wedged master mid-publish."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def set(self, key, value):
+        self.entered.set()
+        self.release.wait(10.0)
+        self._inner.set(key, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_eviction_withdraw_survives_wedged_store():
+    """The regression this PR's lock-discipline fix pins: with the
+    publisher thread WEDGED inside ``store.set``, an over-budget
+    ``put()`` (eviction -> hook -> withdraw) must complete promptly —
+    withdraw only mutates the digest set under the index's own lock,
+    and the tier calls the hook after releasing its lock, so a dead
+    store can never wedge a spill.  Once the store recovers, the next
+    publish advertises the post-withdraw truth."""
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    wedged = _WedgedStore(TCPStore("127.0.0.1", master.port))
+    idx = ClusterPrefixIndex(wedged, host=0, interval=0.01)
+    tier = HostPageTier(budget_bytes=1000)
+    tier.evict_hook = idx.withdraw
+    d1, d2, d3 = b"\x01" * 8, b"\x02" * 8, b"\x03" * 8
+    assert tier.put(d1, _arrays(400)) and tier.put(d2, _arrays(400))
+    idx.offer([d1, d2])
+    idx.start()
+    try:
+        assert wedged.entered.wait(5.0), "publisher never reached set()"
+        t0 = time.time()
+        assert tier.put(d3, _arrays(400))      # evicts d1 -> withdraw
+        assert time.time() - t0 < 2.0, "eviction blocked on the store"
+        assert d1 not in tier
+    finally:
+        wedged.release.set()
+        idx.stop()                             # publishes exit snapshot
+    got = fetch_index(TCPStore("127.0.0.1", master.port), 1)
+    assert got[0] == {d2.hex()}                # d1 withdrawn, d2 kept
