@@ -38,11 +38,25 @@ isolation headline.  ``--ab-assert`` (the CI gate) requires, with
 colocated baseline's in-flight p99 TPOT while the disagg arm inflates
 strictly less.
 
+**Replicated fleet (ISSUE 19).**  ``--replicas N`` serves through the
+router tier (``serving/router.py``): N data-parallel scheduler+engine
+replicas behind ONE front-end, prefix-affinity + least-loaded routing,
+health-probed.  Lines carry ``"replicas": N`` (a trajectory cursor
+axis, so fleet series never compare against single-replica history)
+and the compile-once gate scales to N — each replica compiles each
+watched program exactly once.  ``--kill-replica`` arms the chaos line:
+a ``serve.replica`` HardExit kills one replica mid-drive, its in-flight
+streams requeue onto survivors, and the line hard-asserts
+``dropped_streams == 0`` and ``router.failovers >= 1`` — failover must
+resume streams, not drop them.  The wall-clock fleet-vs-single numbers
+only gate on a TPU backend (CPU replicas share host cores; same
+discipline as every other arm).
+
 The engine runs the OVERLAPPED decode loop (``--overlap off`` for the
 sync A/B) under the STRICT recompile watchdog: the decode program must
 compile exactly once across the whole sweep — admission churn, shed
-bursts, mid-stream disconnects, handoffs and all (the schema gate
-re-checks the reported count; disagg arms also report
+bursts, mid-stream disconnects, handoffs, replica failovers and all
+(the schema gate re-checks the reported count; disagg arms also report
 ``serving.kv_export``/``serving.kv_import`` at exactly 1).
 
 On TPU: GPT-2 345M at serving shapes.  On CPU: the tiny head_dim-64
@@ -56,6 +70,7 @@ import gc
 import json
 import os
 import sys
+import time
 
 
 def main(argv=None):
@@ -115,6 +130,18 @@ def main(argv=None):
                          "devices, so wall-clock isolation there is "
                          "scheduling noise — the bench_schema "
                          "trajectory discipline).  Needs >= 2 devices.")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="serve through the router tier over N "
+                         "data-parallel replicas (1 = classic "
+                         "single-scheduler front-end)")
+    ap.add_argument("--kill-replica", action="store_true",
+                    help="chaos arm (needs --replicas >= 2): HardExit "
+                         "one replica mid-drive at every QPS point; "
+                         "hard-asserts dropped_streams == 0 and "
+                         "router.failovers >= 1")
+    ap.add_argument("--kill-at", type=int, default=20, metavar="K",
+                    help="replica-loop iteration index (across the "
+                         "fleet) at which the kill fires")
     ap.add_argument("--trace-file", default=None, metavar="PATH",
                     help="export the request-scoped span trace (JSONL) "
                          "of the LAST QPS point's drive")
@@ -129,9 +156,13 @@ def main(argv=None):
     from paddle_tpu.observability import flight as _flight
     from paddle_tpu.observability import tracing as _tracing
     from paddle_tpu.observability import watchdog as _wd
+    from paddle_tpu.robustness import faultpoints as fp
     from paddle_tpu.serving import loadgen
     from paddle_tpu.serving.engine import DecodeEngine
     from paddle_tpu.serving.frontend import ServingFrontend
+    from paddle_tpu.serving.router import Router
+    from paddle_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler, Request)
 
     spec = 0 if args.spec in ("off", "0") else int(args.spec)
     overlap = args.overlap == "on"
@@ -148,6 +179,17 @@ def main(argv=None):
     if args.ab_assert and (args.disagg != "ab" or not args.wave):
         raise SystemExit("bench_serve: --ab-assert needs --disagg ab "
                          "and --wave N")
+    if args.replicas < 1:
+        raise SystemExit("bench_serve: --replicas must be >= 1")
+    if args.replicas > 1 and (args.disagg != "off" or args.tp > 1
+                              or args.wave):
+        raise SystemExit("bench_serve: --replicas composes with none of "
+                         "--disagg/--tp/--wave yet — data-parallel "
+                         "replicas are whole serving stacks; run those "
+                         "axes per-replica in their own sweeps")
+    if args.kill_replica and args.replicas < 2:
+        raise SystemExit("bench_serve: --kill-replica needs "
+                         "--replicas >= 2 (a failover needs a survivor)")
     if args.ab_assert and len(devices) < 2:
         raise SystemExit(
             "bench_serve: --ab-assert needs >= 2 devices so the prefill "
@@ -193,24 +235,60 @@ def main(argv=None):
             decode_dev, prefill_dev = devices[0], devices[1]
         else:
             decode_dev = prefill_dev = None
-        engine = DecodeEngine(model, num_slots=num_slots,
-                              max_len=max_len, seed=0,
-                              page_size=page_size, kv_dtype=kv_dtype,
-                              spec_k=spec, tracer=tracer, tp=args.tp,
-                              device=decode_dev)
+        router = None
         prefill_engine = None
-        if disagg:
-            prefill_engine = DecodeEngine(
-                model, num_slots=max(2, num_slots // 2),
-                max_len=max_len, seed=0, page_size=page_size,
-                kv_dtype=kv_dtype, tracer=tracer, device=prefill_dev)
-        fe = ServingFrontend(engine, queue_limit=args.queue_limit,
-                             overlap=overlap, tracer=tracer,
-                             prefill_engine=prefill_engine)
+        if args.replicas > 1:
+            engines = [DecodeEngine(model, num_slots=num_slots,
+                                    max_len=max_len, seed=0,
+                                    page_size=page_size,
+                                    kv_dtype=kv_dtype, spec_k=spec,
+                                    tracer=tracer, tp=args.tp)
+                       for _ in range(args.replicas)]
+            engine = engines[0]
+            # deterministic per-replica warmup: routing is load-shaped,
+            # so an HTTP warmup drive cannot GUARANTEE every replica
+            # compiles before the measured (strict-watchdog, compile-
+            # once-gated) points — drive each engine directly instead;
+            # the compiled programs are engine-owned and survive into
+            # the router's own schedulers
+            for eng in engines:
+                s = ContinuousBatchingScheduler(eng, overlap=overlap)
+                s.submit(Request(
+                    prompt=np.arange(1, page_size + 1, dtype=np.int32),
+                    max_new_tokens=4, temperature=0.0))
+                while s.has_work():
+                    s.step()
+            router = Router(engines, tracer=tracer, overlap=overlap,
+                            respawn_delay=0.1, healthy_interval=0.5)
+            fe = ServingFrontend(router=router,
+                                 queue_limit=args.queue_limit,
+                                 tracer=tracer)
+        else:
+            engine = DecodeEngine(model, num_slots=num_slots,
+                                  max_len=max_len, seed=0,
+                                  page_size=page_size, kv_dtype=kv_dtype,
+                                  spec_k=spec, tracer=tracer, tp=args.tp,
+                                  device=decode_dev)
+            if disagg:
+                prefill_engine = DecodeEngine(
+                    model, num_slots=max(2, num_slots // 2),
+                    max_len=max_len, seed=0, page_size=page_size,
+                    kv_dtype=kv_dtype, tracer=tracer, device=prefill_dev)
+            fe = ServingFrontend(engine, queue_limit=args.queue_limit,
+                                 overlap=overlap, tracer=tracer,
+                                 prefill_engine=prefill_engine)
         host, port = fe.start()
         last_wave = None
+
+        def fleet_gap_steps():
+            scheds = [r.scheduler for r in router.replicas]
+            return (sum(s.host_gap_seconds for s in scheds),
+                    sum(s.decode_steps_total for s in scheds))
+
         try:
             # warmup drive: compiles prefill + decode (+ handoff) once
+            # (fleet replicas were warmed deterministically above; this
+            # warms the HTTP/admission path)
             loadgen.run_load_sync(host, port, qps=max(qps_list),
                                   n_requests=2, mix=args.mix, seed=99,
                                   vocab=cfg.vocab_size)
@@ -224,20 +302,53 @@ def main(argv=None):
                 if tracer is not None:
                     tracer.reset()
                 sched = fe.scheduler
-                gap0 = sched.host_gap_seconds
-                steps0 = sched.decode_steps_total
-                ho_bytes0 = getattr(sched, "handoff_bytes_total", 0)
-                ho_n0 = getattr(sched, "handoffs_total", 0)
+                if router is not None:
+                    gap0, steps0 = fleet_gap_steps()
+                    ho_bytes0 = ho_n0 = 0
+                else:
+                    gap0 = sched.host_gap_seconds
+                    steps0 = sched.decode_steps_total
+                    ho_bytes0 = getattr(sched, "handoff_bytes_total", 0)
+                    ho_n0 = getattr(sched, "handoffs_total", 0)
+                plan = None
+                if args.kill_replica:
+                    # the chaos plan is scoped to the MEASURED drive
+                    # only (the warmup fires the same site); the kill
+                    # lands a few fleet-loop iterations in, while
+                    # streams are in flight
+                    plan = fp.FaultPlan()
+                    plan.inject("serve.replica", fp.HardExit(),
+                                at=args.kill_at)
                 if args.wave:
                     summary = loadgen.run_interference_sync(
                         host, port, qps=qps, n_requests=requests,
                         mix=args.mix, wave_n=args.wave, seed=0,
                         vocab=cfg.vocab_size,
                         repeats=args.wave_repeats)
+                elif plan is not None:
+                    with fp.chaos(plan):
+                        summary = loadgen.run_load_sync(
+                            host, port, qps=qps, n_requests=requests,
+                            mix=args.mix, seed=0, vocab=cfg.vocab_size)
+                    plan.assert_all_fired()
                 else:
                     summary = loadgen.run_load_sync(
                         host, port, qps=qps, n_requests=requests,
                         mix=args.mix, seed=0, vocab=cfg.vocab_size)
+                failovers = (int(obs.counter("router.failovers").value)
+                             if router is not None else 0)
+                if plan is not None:
+                    # the killed replica must respawn and rejoin before
+                    # the next point measures a degraded fleet
+                    deadline = time.monotonic() + 10.0
+                    while (router.healthy_count() < args.replicas
+                           and time.monotonic() < deadline):
+                        time.sleep(0.05)
+                    if router.healthy_count() < args.replicas:
+                        raise SystemExit(
+                            "bench_serve: killed replica did not rejoin "
+                            "within 10s (states %r)"
+                            % (router.replica_states(),))
 
                 def _pcts(name):
                     h = obs.histogram(name)
@@ -274,6 +385,7 @@ def main(argv=None):
                     "tp": args.tp,
                     "overlap": overlap,
                     "disagg": bool(disagg),
+                    "replicas": args.replicas,
                     # client-observed latency (the acceptance numbers)
                     "ttft_p50_ms": summary["ttft_p50_ms"],
                     "ttft_p99_ms": summary["ttft_p99_ms"],
@@ -288,8 +400,12 @@ def main(argv=None):
                     "goodput_tokens": summary["goodput_tokens"],
                     "wall_s": summary["wall_s"],
                     "host_gap_ms_per_step": round(
-                        1e3 * (sched.host_gap_seconds - gap0)
-                        / max(sched.decode_steps_total - steps0, 1), 4),
+                        1e3 * max(
+                            (fleet_gap_steps()[0] if router is not None
+                             else sched.host_gap_seconds) - gap0, 0.0)
+                        / max((fleet_gap_steps()[1] if router is not None
+                               else sched.decode_steps_total) - steps0,
+                              1), 4),
                     "metrics": {
                         "histograms": hists,
                         "compile_counts": {
@@ -317,6 +433,27 @@ def main(argv=None):
                         engine.handoff_pages
                     line["config"]["prefill_device"] = \
                         str(prefill_dev) if prefill_dev else "shared"
+                if router is not None:
+                    line["dropped_streams"] = \
+                        summary["dropped_streams"]
+                    line["failovers"] = failovers
+                    line["replicas_healthy"] = router.healthy_count()
+                    line["config"]["kill_replica"] = args.kill_replica
+                if args.kill_replica:
+                    # the chaos line's hard gates: failover resumes
+                    # streams (zero drops) and at least one failover
+                    # actually happened (a vacuous kill must not pass)
+                    if summary["dropped_streams"]:
+                        raise SystemExit(
+                            "bench_serve: %d accepted streams dropped "
+                            "through the replica kill at qps=%s — "
+                            "failover must resume streams, not drop "
+                            "them" % (summary["dropped_streams"], qps))
+                    if failovers < 1:
+                        raise SystemExit(
+                            "bench_serve: --kill-replica drive recorded "
+                            "no router.failovers at qps=%s — the chaos "
+                            "line was vacuous" % qps)
                 if "wave" in summary:
                     line["wave"] = summary["wave"]
                     last_wave = summary["wave"]

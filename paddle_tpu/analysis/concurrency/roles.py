@@ -71,6 +71,7 @@ class RoleRegistry:
 
 
 _FRONTEND = "paddle_tpu.serving.frontend"
+_ROUTER = "paddle_tpu.serving.router"
 _SCHED = "paddle_tpu.serving.scheduler"
 _DISAGG = "paddle_tpu.serving.disagg"
 _KVT = "paddle_tpu.serving.kv_tier"
@@ -100,6 +101,14 @@ DEFAULT_REGISTRY = RoleRegistry(
             f"{_FRONTEND}:ServingFrontend._on_token",
             f"{_FRONTEND}:ServingFrontend._on_finish",
             f"{_FRONTEND}:_Stream.push",
+            # fleet mode (ISSUE 19): each replica thread IS a scheduler
+            # thread — _loop is the sole caller of its scheduler, and
+            # the router's token/finish wrappers fire on it before
+            # forwarding to the frontend callbacks above
+            f"{_ROUTER}:_Replica._run",
+            f"{_ROUTER}:_Replica._loop",
+            f"{_ROUTER}:Router._make_callbacks.on_token",
+            f"{_ROUTER}:Router._make_callbacks.on_finish",
         ),
         "event_loop": (
             f"{_FRONTEND}:ServingFrontend._loop_main",
@@ -111,6 +120,9 @@ DEFAULT_REGISTRY = RoleRegistry(
             f"{_FRONTEND}:ServingFrontend._respond_json",
             f"{_FRONTEND}:ServingFrontend._read_request",
             f"{_FRONTEND}:ServingFrontend._cancel_stream",
+            # fleet-mode admission callback: router.submit runs it on
+            # the loop thread before the replica can emit a token
+            f"{_FRONTEND}:ServingFrontend._generate._admitted",
         ),
         "writer": (
             f"{_CKPT}:CheckpointManager._drain",
@@ -123,12 +135,21 @@ DEFAULT_REGISTRY = RoleRegistry(
         "monitor": (
             f"{_LIVE}:LivenessMonitor._run",
             f"{_ELASTIC}:ElasticManager._hb_loop",
+            # the router health probe: refreshes telemetry/prefix views,
+            # trips stall/death detection, respawns dead replicas
+            f"{_ROUTER}:Router._probe_main",
+            f"{_ROUTER}:Router.probe_once",
         ),
         "main": (
             f"{_FRONTEND}:ServingFrontend.start",
             f"{_FRONTEND}:ServingFrontend.stop",
             f"{_FRONTEND}:ServingFrontend.drain",
             f"{_FRONTEND}:ServingFrontend.wait_drained",
+            f"{_ROUTER}:Router.start",
+            f"{_ROUTER}:Router.stop",
+            f"{_ROUTER}:Router.submit",
+            f"{_ROUTER}:Router.cancel",
+            f"{_ROUTER}:Router.decommission",
             f"{_CKPT}:CheckpointManager.save",
             f"{_CKPT}:CheckpointManager.wait",
             f"{_CKPT}:CheckpointManager.close",

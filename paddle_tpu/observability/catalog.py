@@ -132,6 +132,24 @@ CATALOG = {
         "fetch (interleaved between decode steps; the repeat-prompt "
         "TTFT includes this window)", unit="seconds"),
 
+    # -- replicated serving fleet (serving/router.py — ISSUE 19) ------------
+    "router.routed": _m(
+        "counter", "admission routing decisions by ladder rung: "
+        "affinity (prefix-digest view covered a non-empty prompt "
+        "prefix), least_loaded (fresh-snapshot fallback, incl. the "
+        "telemetry-blackout round-robin), failover (an orphaned "
+        "in-flight request re-placed onto a survivor)",
+        labels=("reason",)),
+    "router.replicas_healthy": _m(
+        "gauge", "replicas currently in the routable set (healthy — "
+        "excludes dead, respawn-pending, and joining replicas still "
+        "inside their healthy interval)"),
+    "router.failovers": _m(
+        "counter", "replica deaths the router failed over (crash at "
+        "the serve.replica site, stalled step beacon past the "
+        "deadline, or a dead thread) — each drains that replica's "
+        "in-flight requests onto survivors via recompute requeue"),
+
     # -- serving front-end (serving/frontend.py — ISSUE 13) -----------------
     "serving.http_requests": _m(
         "counter", "HTTP requests by response status code (200 stream/"

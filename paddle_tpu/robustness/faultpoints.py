@@ -38,6 +38,7 @@ __all__ = [
     "active_plan", "SITES",
     "Raise", "DiskFull", "TornFile", "BitFlip", "SocketReset", "NaNBatch",
     "ForceFoundInf", "Preempt", "HardExit", "Hang",
+    "CrashScopeExit", "crash_scope",
 ]
 
 #: name -> one-line description of what failure the site simulates.
@@ -202,14 +203,54 @@ class Preempt(FaultAction):
         preemption.simulate()
 
 
+class CrashScopeExit(BaseException):
+    """A :class:`HardExit` that fired inside a :func:`crash_scope`.
+
+    ``BaseException`` on purpose: the scope models a *process death*, so
+    no ``except Exception`` recovery handler between the faultpoint and
+    the scope boundary may swallow it — only the harness that opened the
+    scope (the router's replica thread, a test worker) catches it and
+    dies the way the real process would."""
+
+    def __init__(self, rc: int = 137):
+        super().__init__("simulated process crash (rc=%d)" % rc)
+        self.rc = rc
+
+
+_CRASH_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def crash_scope():
+    """Contain :class:`HardExit` to the current thread.
+
+    In-process fault drills that model one *process* per thread (the
+    serving router runs one scheduler+engine replica per thread) need a
+    replica crash to kill the replica, not the test runner: inside this
+    scope a fired ``HardExit`` raises :class:`CrashScopeExit` instead of
+    calling ``os._exit``.  Subprocess chaos scripts keep the real thing
+    by simply not opening a scope.  Thread-local and re-entrant."""
+    prev = getattr(_CRASH_SCOPE, "active", False)
+    _CRASH_SCOPE.active = True
+    try:
+        yield
+    finally:
+        _CRASH_SCOPE.active = prev
+
+
 class HardExit(FaultAction):
     """``os._exit(rc)`` — a crash with no cleanup, for subprocess chaos
-    scripts that die mid-write."""
+    scripts that die mid-write.  Inside a :func:`crash_scope` the same
+    injection degrades to raising :class:`CrashScopeExit` so an
+    in-process replica thread can die like the process it stands in
+    for without taking the host process down."""
 
     def __init__(self, rc: int = 137):
         self.rc = rc
 
     def fire(self, ctx, plan):
+        if getattr(_CRASH_SCOPE, "active", False):
+            raise CrashScopeExit(self.rc)
         os._exit(self.rc)
 
 

@@ -1760,6 +1760,37 @@ class DecodeEngine:
         tier = self._host_tier
         return 0 if tier is None else tier.bytes_used()
 
+    def prefix_digest_snapshot(self):
+        """Advisory copy of every chained page digest this engine can
+        serve a prefix hit from: the device pool's hash table, the
+        host tier, and anything the attached cluster index still
+        offers.  The router's prefix-affinity probe (ISSUE 19) calls
+        this cross-thread while the replica keeps decoding — a
+        concurrent mutation just yields a marginally stale set (one
+        bounded retry, then next probe refreshes), which is fine
+        because affinity is a routing HINT: admission re-derives exact
+        coverage under the allocator's own bookkeeping."""
+        digs = set()
+        if not self.paged:
+            return digs
+        for _ in range(4):
+            try:
+                digs = set(self._alloc._hash_to_page)
+                tier = self._host_tier
+                if tier is not None:
+                    digs.update(tier.digests())
+                break
+            except RuntimeError:   # dict mutated under the iteration
+                digs = set()
+                continue
+        if self._kv_index is not None:
+            from .kv_tier import _hex
+            digs = {_hex(d) for d in digs}
+            digs.update(self._kv_index.snapshot_digests())
+            return digs
+        from .kv_tier import _hex
+        return {_hex(d) for d in digs}
+
     def attach_cluster_index(self, store, host=None, interval=None,
                              start=True):
         """Wire a TCPStore-backed ClusterPrefixIndex to this engine:

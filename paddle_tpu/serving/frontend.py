@@ -132,9 +132,9 @@ class ServingFrontend:
     admission queue, and a preemption-guarded drain.  ``port=0`` binds
     an ephemeral port (read :attr:`port` after :meth:`start`)."""
 
-    def __init__(self, engine, host="127.0.0.1", port=0, queue_limit=64,
-                 overlap=None, guard=None, tracer=None,
-                 prefill_engine=None, handoff_limit=4):
+    def __init__(self, engine=None, host="127.0.0.1", port=0,
+                 queue_limit=64, overlap=None, guard=None, tracer=None,
+                 prefill_engine=None, handoff_limit=4, router=None):
         self.engine = engine
         self.host = host
         self.port = int(port)
@@ -142,7 +142,27 @@ class ServingFrontend:
         self._guard = guard
         self._tracer = (tracer if tracer is not None
                         else _tracing.default_tracer())
-        if prefill_engine is not None:
+        self._router = router
+        if router is not None:
+            # replicated fleet mode (ISSUE 19): the router owns the
+            # replicas and their scheduler threads — the front-end runs
+            # NO scheduler thread of its own; handlers call
+            # router.submit (pure-CPU hashing + lock-scoped enqueue,
+            # never a scheduler call) straight from the event loop and
+            # the token/finish callbacks arrive from replica threads.
+            # A guard is the single-scheduler drain path; fleets drain
+            # via drain()/stop().
+            if engine is not None or prefill_engine is not None:
+                raise ValueError("pass engines THROUGH the router in "
+                                 "fleet mode, not to the front-end")
+            if guard is not None:
+                raise ValueError("preemption guard is not supported in "
+                                 "fleet mode — use drain()")
+            self.scheduler = None
+            self._prompt_cap = router.prompt_cap
+            router.on_token = self._on_token
+            router.on_finish = self._on_finish
+        elif prefill_engine is not None:
             # disaggregated prefill/decode (ISSUE 15): admissions route
             # to the prefill engine and finished KV hands off into the
             # decode pool; the HTTP surface is unchanged
@@ -151,10 +171,12 @@ class ServingFrontend:
                 engine, prefill_engine, handoff_limit=handoff_limit,
                 tracer=tracer, overlap=overlap,
                 on_token=self._on_token, on_finish=self._on_finish)
+            self._prompt_cap = engine.prompt_cap
         else:
             self.scheduler = ContinuousBatchingScheduler(
                 engine, tracer=tracer, overlap=overlap,
                 on_token=self._on_token, on_finish=self._on_finish)
+            self._prompt_cap = engine.prompt_cap
         # command queues (handler threads -> scheduler thread)
         self._lock = threading.Lock()
         self._pending = []                # [(Request, _Stream)]
@@ -190,10 +212,13 @@ class ServingFrontend:
         self._started.wait(10.0)
         if not self._started.is_set():
             raise RuntimeError("frontend event loop failed to start")
-        self._sched_thread = threading.Thread(
-            target=self._sched_main, name="serve-frontend-sched",
-            daemon=True)
-        self._sched_thread.start()
+        if self._router is not None:
+            self._router.start()
+        else:
+            self._sched_thread = threading.Thread(
+                target=self._sched_main, name="serve-frontend-sched",
+                daemon=True)
+            self._sched_thread.start()
         return self.host, self.port
 
     def stop(self, timeout=30.0):
@@ -206,6 +231,8 @@ class ServingFrontend:
             self._draining = True
             self._stop = True
         self._wake.set()
+        if self._router is not None:
+            self._router.stop(timeout)
         if self._sched_thread is not None:
             self._sched_thread.join(timeout)
         if self._loop is not None:
@@ -221,6 +248,11 @@ class ServingFrontend:
         completion."""
         with self._lock:
             self._draining = True
+            drained = self._router is not None and self._outstanding == 0
+        if drained:
+            # fleet mode completes the drain from finish callbacks; an
+            # already-idle fleet would otherwise never observe one
+            self._drained.set()
         self._wake.set()
 
     @property
@@ -273,7 +305,8 @@ class ServingFrontend:
                             "queue_wait_ms": 0.0}))
                         continue
                     stream.rid = rid
-                    self._streams[rid] = stream
+                    with self._lock:
+                        self._streams[rid] = stream
                     # the network-facing lifetime on the request lane:
                     # child of the scheduler's "request" root so the
                     # trace tree stays connected
@@ -324,27 +357,39 @@ class ServingFrontend:
             self._drained.set()
             # never leave a connected client awaiting a queue that can
             # no longer be fed — flush an error-done to every stream
-            for stream in list(self._streams.values()):
+            with self._lock:
+                streams = list(self._streams.values())
+                self._streams.clear()
+            for stream in streams:
                 stream.push(("done", {"rid": stream.rid,
                                       "finish_reason": "error",
                                       "tokens": [], "ttft_ms": 0.0,
                                       "tpot_ms": 0.0,
                                       "queue_wait_ms": 0.0}))
-            self._streams.clear()
         finally:
             b.done()      # thread exiting: stop watching this beacon
 
     # scheduler-thread callbacks -------------------------------------------
 
     def _on_token(self, rid, toks):
-        stream = self._streams.get(rid)
+        # classic mode fires this from the scheduler thread; fleet mode
+        # from whichever replica thread owns the rid — _streams is
+        # lock-guarded so registration (loop thread) can't race it
+        with self._lock:
+            stream = self._streams.get(rid)
         if stream is not None:
             stream.push(("tokens", list(toks)))
 
     def _on_finish(self, result):
-        stream = self._streams.pop(result.rid, None)
         with self._lock:
+            stream = self._streams.pop(result.rid, None)
             self._outstanding -= 1
+            # fleet mode has no scheduler loop to observe quiescence, so
+            # the drain completes at the last finish callback
+            drained = (self._router is not None and self._draining
+                       and self._outstanding == 0)
+        if drained:
+            self._drained.set()
         if stream is None:
             return
         if stream.http_span is not None:
@@ -425,21 +470,37 @@ class ServingFrontend:
                 beacons = _liveness.state()
                 stalled = sorted(n for n, s in beacons.items()
                                  if s["stalled"])
-                await self._respond_json(writer, 200, {
+                doc = {
                     "status": ("stalled" if stalled else
                                "draining" if self._draining else "ok"),
                     "stalled": stalled,
                     "beacons": beacons,
                     "open_streams": self._open_streams,
                     "outstanding": self._outstanding,
-                    "queue_depth": len(self.scheduler.waiting),
-                    "slots_active": sum(
-                        a is not None for a in self.scheduler.slots),
-                    # disaggregated schedulers also expose the handoff
-                    # pipeline depth (0 when absent/colocated)
-                    "handoff_depth": getattr(self.scheduler,
-                                             "handoff_depth", 0),
-                })
+                }
+                if self._router is not None:
+                    # fleet view: depths are summed across replicas and
+                    # the per-replica lifecycle state is spelled out so
+                    # an external probe can see a respawn in flight
+                    doc.update({
+                        "queue_depth": self._router.queue_depth(),
+                        "slots_active": self._router.slots_active(),
+                        "handoff_depth": 0,
+                        "replicas": self._router.replica_states(),
+                        "replicas_healthy":
+                            self._router.healthy_count(),
+                    })
+                else:
+                    doc.update({
+                        "queue_depth": len(self.scheduler.waiting),
+                        "slots_active": sum(
+                            a is not None for a in self.scheduler.slots),
+                        # disaggregated schedulers also expose the
+                        # handoff pipeline depth (0 when absent)
+                        "handoff_depth": getattr(self.scheduler,
+                                                 "handoff_depth", 0),
+                    })
+                await self._respond_json(writer, 200, doc)
                 return
             if method != "POST" or path != "/v1/generate":
                 await self._respond_json(writer, 404,
@@ -467,9 +528,9 @@ class ServingFrontend:
                 eos_token_id=payload.get("eos_token_id"))
             if prompt.size < 1:
                 raise ValueError("empty prompt")
-            if prompt.size > self.engine.prompt_cap:
+            if prompt.size > self._prompt_cap:
                 raise ValueError("prompt length %d exceeds capacity %d"
-                                 % (prompt.size, self.engine.prompt_cap))
+                                 % (prompt.size, self._prompt_cap))
             if req.max_new_tokens < 1:
                 raise ValueError("max_new_tokens must be >= 1")
             stream_mode = bool(payload.get("stream", True))
@@ -493,9 +554,39 @@ class ServingFrontend:
             await self._respond_json(writer, 429, {"error": "overloaded"})
             return
         stream = _Stream(asyncio.get_running_loop())
-        with self._lock:
-            self._pending.append((req, stream))
-        self._wake.set()
+        if self._router is not None:
+            # fleet mode: route NOW, on the loop thread — submit() is
+            # pure-CPU (digest chain + lock-scoped enqueue onto the
+            # chosen replica's command queue), never a scheduler call.
+            # on_admit runs BEFORE any replica thread can emit a token
+            # for this rid, so the stream registration can't lose a
+            # token to the callback racing the admission.
+            def _admitted(rid, root):
+                stream.rid = rid
+                with self._lock:
+                    self._streams[rid] = stream
+                stream.http_span = self._tracer.span(
+                    "http", parent=root)
+            from .router import NoHealthyReplicas
+            try:
+                self._router.submit(req, on_admit=_admitted)
+            except NoHealthyReplicas:
+                with self._lock:
+                    self._outstanding -= 1
+                self._m_shed.inc()
+                await self._respond_json(
+                    writer, 503, {"error": "no healthy replicas"})
+                return
+            except ValueError as e:
+                with self._lock:
+                    self._outstanding -= 1
+                await self._respond_json(writer, 400,
+                                         {"error": str(e)})
+                return
+        else:
+            with self._lock:
+                self._pending.append((req, stream))
+            self._wake.set()
         if stream_mode:
             await self._stream_response(writer, stream)
         else:
@@ -560,8 +651,13 @@ class ServingFrontend:
         iteration boundary).  Pre-submit, just mark the stream."""
         stream.cancelled = True
         if stream.rid is not None:
-            with self._lock:
-                self._cancels.append(stream.rid)
+            if self._router is not None:
+                # the router forwards to the owning replica's command
+                # queue (lock-scoped, non-blocking from the loop thread)
+                self._router.cancel(stream.rid)
+            else:
+                with self._lock:
+                    self._cancels.append(stream.rid)
         self._wake.set()
 
     # -- http plumbing -----------------------------------------------------

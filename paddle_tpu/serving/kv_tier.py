@@ -341,6 +341,16 @@ class ClusterPrefixIndex:
             for d in digests:
                 self._digests.pop(_hex(d), None)
 
+    def snapshot_digests(self) -> Set[str]:
+        """The currently offered hex digest set — what the next
+        :meth:`publish_once` would ship.  The router's prefix-affinity
+        consultation (ISSUE 19) reads this for in-process replicas
+        instead of round-tripping the store; advisory like the
+        published view (a stale entry just mis-scores one routing
+        decision — admission re-derives exact coverage)."""
+        with self._lock:
+            return set(self._digests)
+
     def publish_once(self) -> str:
         with self._lock:
             digests = list(self._digests)

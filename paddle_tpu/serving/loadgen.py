@@ -313,6 +313,12 @@ def summarize(recs: List[dict], wall_s: float, qps: float,
                                            "connection_error")]
     shed = [r for r in recs if r["status"] in (429, 503)]
     n_errors = len(recs) - len(done) - len(shed)
+    # a stream the server ACCEPTED (200) but never finished cleanly: the
+    # number the replica-kill chaos line hard-asserts to be zero —
+    # failover must resume streams, not drop them
+    dropped = [r for r in recs if r["status"] == 200
+               and r["finish_reason"] in (None, "error",
+                                          "connection_error")]
     goodput_tokens = sum(r["tokens"] for r in done)
     ttfts = [r["ttft"] for r in done if r["ttft"] is not None]
     tpots = [r["tpot"] for r in done if r["tpot"] is not None]
@@ -323,6 +329,7 @@ def summarize(recs: List[dict], wall_s: float, qps: float,
         "completed": len(done),
         "shed": len(shed),
         "errors": n_errors,
+        "dropped_streams": len(dropped),
         "shed_rate": round(len(shed) / max(len(recs), 1), 4),
         "goodput_tokens": goodput_tokens,
         "goodput_tokens_per_sec": round(goodput_tokens / wall_s, 2)

@@ -44,7 +44,7 @@ import numpy as np
 
 from ..observability import tracing as _tracing
 
-__all__ = ["PageAllocator", "PagePoolExhausted"]
+__all__ = ["PageAllocator", "PagePoolExhausted", "prompt_digest_chain"]
 
 
 class PagePoolExhausted(RuntimeError):
@@ -59,6 +59,26 @@ def _digest(prev: bytes, tokens: np.ndarray, partial: bool) -> bytes:
     if partial:
         h.update(b"|partial")
     return h.digest()
+
+
+def prompt_digest_chain(ids: np.ndarray, page_size: int) -> List[bytes]:
+    """The chained FULL-page digests of a prompt, allocator-free.
+
+    This is the prefix-affinity consultation key (ISSUE 19): the router
+    hashes a prompt ONCE and intersects the chain against each
+    replica's advertised digest set (device hash table + host tier +
+    cluster index, all chained with the same ``_digest``) to find the
+    replica covering the longest prefix.  The partial tail is omitted
+    on purpose — affinity scores whole pages; a tail hit moves the
+    score by less than one page and admission re-derives exact coverage
+    anyway."""
+    ids = np.asarray(ids, np.int32).reshape(-1)
+    out, prev = [], b""
+    for i in range(len(ids) // page_size):
+        prev = _digest(prev, ids[i * page_size:(i + 1) * page_size],
+                       partial=False)
+        out.append(prev)
+    return out
 
 
 class PageAllocator:

@@ -116,7 +116,8 @@ from .engine import PagePoolExhausted, PrefillTask
 from .kv_tier import TRANSPORT_ERRORS as _TIER_ERRORS
 from .spec import propose as _propose_draft
 
-__all__ = ["Request", "RequestResult", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "RequestResult", "RequeueState",
+           "ContinuousBatchingScheduler"]
 
 #: chaos site on the scheduler's hot iteration, INSIDE the liveness
 #: beacon's guard: a scheduled ``Hang`` here simulates a wedged decode
@@ -168,6 +169,37 @@ class RequestResult:
                                          # proposed; 0/0 when spec off)
     trace_id: int = 0                    # request lane in the span trace
                                          # (ISSUE 9; 0 = tracing disabled)
+
+
+@dataclasses.dataclass
+class RequeueState:
+    """Portable snapshot of ONE unfinished request — the unit of
+    scheduler-to-scheduler transfer (ISSUE 19 replica failover, and
+    graceful replica decommission).  Produced by
+    :meth:`ContinuousBatchingScheduler.export_requeue_state` or
+    synthesized by the router from its own admission records when the
+    owning replica died too hard to export anything; consumed by
+    :meth:`ContinuousBatchingScheduler.import_requeue`, which feeds it
+    through the existing recompute-preemption resume path — the
+    survivor re-prefills ``prompt + generated`` and the stream picks up
+    at the next token."""
+    req: Request                          # rid already assigned
+    generated: List[int] = dataclasses.field(default_factory=list)
+    submit_t: float = 0.0                 # original perf_counter stamp
+    first_tok_t: Optional[float] = None   # preserved across the hop
+    requeues: int = 0                     # prior evictions + failovers
+                                          # (seeds _preempt_count: one
+                                          # max_preemptions-style bound
+                                          # covers both)
+    trace_id: int = 0
+    root_span: object = None              # live "request" span, adopted
+    queue_wait: Optional[float] = None    # None = never admitted (the
+                                          # survivor observes it once)
+    decode_s: float = 0.0
+    decode_steps: int = 0
+    prefix_hit_tokens: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 class _ActiveSlot:
@@ -368,7 +400,7 @@ class ContinuousBatchingScheduler:
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, req: Request) -> int:
+    def submit(self, req: Request, trace=None) -> int:
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -379,18 +411,32 @@ class ContinuousBatchingScheduler:
                 % (prompt.size, cap))
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        req = dataclasses.replace(req, prompt=prompt, rid=self._next_rid)
-        self._next_rid += 1
+        # a pre-assigned rid (the router tier mints fleet-unique ids so
+        # a stream's rid survives failover to another replica) is
+        # honored; local callers keep the auto-assigned counter
+        if req.rid is None:
+            req = dataclasses.replace(req, prompt=prompt,
+                                      rid=self._next_rid)
+        else:
+            req = dataclasses.replace(req, prompt=prompt)
+        self._next_rid = max(self._next_rid, req.rid + 1)
         self._submit_t[req.rid] = time.perf_counter()
         self.waiting.append(req)
         # the trace is born HERE: root "request" span + the initial
-        # "queue" child (ended at admission).  No-op identity calls when
-        # tracing is disabled.
-        tid = self._tracer.new_trace()
-        root = self._tracer.span(
-            "request", trace_id=tid, rid=req.rid,
-            prompt_len=int(prompt.size),
-            max_new_tokens=int(req.max_new_tokens))
+        # "queue" child (ended at admission) — unless the caller already
+        # minted the lane (``trace=(trace_id, root_span)``: the router
+        # owns the request root so the tree survives failover).  No-op
+        # identity calls when tracing is disabled.
+        if trace is None:
+            tid = self._tracer.new_trace()
+            root = self._tracer.span(
+                "request", trace_id=tid, rid=req.rid,
+                prompt_len=int(prompt.size),
+                max_new_tokens=int(req.max_new_tokens))
+        else:
+            tid, root = trace
+            if root is None:
+                root = _tracing.NOOP_SPAN
         self._trace_ids[req.rid] = tid
         self._req_spans[req.rid] = root
         self._wait_spans[req.rid] = self._tracer.span("queue", parent=root)
@@ -1251,6 +1297,116 @@ class ContinuousBatchingScheduler:
                     self._on_finish(res)
                 return True
         return False
+
+    # -- replica failover: in-flight state transfer (ISSUE 19) -------------
+
+    def import_requeue(self, state: "RequeueState") -> int:
+        """Adopt one transferred request through the recompute-preemption
+        resume path (ISSUE 19 failover / decommission).  The request
+        lands at the FRONT of the waiting queue (it already waited on
+        its old replica); when it has partial generated tokens a parked
+        :class:`_ActiveSlot` is reconstructed so re-admission
+        re-prefills ``prompt + generated`` exactly like a page-pressure
+        eviction resume — the stream continues at the next token,
+        mostly prefix-hitting whatever of the prompt this engine's
+        cache already covers.  Timing state (submit_t, first_tok_t,
+        decode_s) and the trace lane travel with it; ``state.requeues``
+        seeds ``_preempt_count`` so failovers and evictions share one
+        ``max_preemptions``-style budget.  Must run on the scheduler's
+        thread.  Returns the rid."""
+        req = state.req
+        rid = req.rid
+        assert rid is not None, "RequeueState.req must carry its rid"
+        self._next_rid = max(self._next_rid, rid + 1)
+        self._submit_t[rid] = state.submit_t
+        if state.requeues:
+            self._preempt_count[rid] = state.requeues
+        root = state.root_span
+        if root is None:
+            root = _tracing.NOOP_SPAN
+        self._trace_ids[rid] = state.trace_id
+        self._req_spans[rid] = root
+        if state.queue_wait is not None:
+            # it was admitted before: park a reconstructed slot so the
+            # resume path restores tokens + timing and queue_wait is
+            # NOT observed a second time
+            act = _ActiveSlot(req, state.submit_t, state.queue_wait,
+                              admit_order=0)
+            act.generated = list(state.generated)
+            act.first_tok_t = state.first_tok_t
+            act.decode_s = state.decode_s
+            act.decode_steps = state.decode_steps
+            act.prefix_hit_tokens = state.prefix_hit_tokens
+            act.spec_proposed = state.spec_proposed
+            act.spec_accepted = state.spec_accepted
+            self._preempted[rid] = act
+            root.event("failover_import", tokens=len(act.generated))
+            self._wait_spans[rid] = self._tracer.span(
+                "requeue", parent=root, rework=True)
+        else:
+            root.event("failover_import", tokens=0)
+            self._wait_spans[rid] = self._tracer.span("queue",
+                                                      parent=root)
+        self.waiting.appendleft(req)
+        self._m_queue_depth.set(len(self.waiting))
+        return rid
+
+    def export_requeue_state(self) -> List["RequeueState"]:
+        """Drain EVERY unfinished request into portable
+        :class:`RequeueState` records, leaving this scheduler empty —
+        the graceful half of replica failover (decommission / drain);
+        the crash half is synthesized router-side from its admission
+        records, since a dead replica exports nothing.  Slots and
+        fetch-lane requests free their pages refcount-exactly on the
+        way out.  Must run on the scheduler's thread."""
+        self._drain_inflight()
+        out: List[RequeueState] = []
+
+        def _carry(req, act, queue_wait):
+            rid = req.rid
+            ws = self._wait_spans.pop(rid, None)
+            if ws is not None:
+                ws.end()
+            root = self._req_spans.pop(rid, None)
+            if root is not None and root is not _tracing.NOOP_SPAN:
+                root.event("exported")
+            st = RequeueState(
+                req=req,
+                submit_t=self._submit_t.pop(rid, 0.0),
+                requeues=self._preempt_count.pop(rid, 0),
+                trace_id=self._trace_ids.pop(rid, 0),
+                root_span=root,
+                queue_wait=queue_wait)
+            if act is not None:
+                st.generated = list(act.generated)
+                st.first_tok_t = act.first_tok_t
+                st.queue_wait = act.queue_wait
+                st.decode_s = act.decode_s
+                st.decode_steps = act.decode_steps
+                st.prefix_hit_tokens = act.prefix_hit_tokens
+                st.spec_proposed = act.spec_proposed
+                st.spec_accepted = act.spec_accepted
+            out.append(st)
+
+        for idx, act in enumerate(self.slots):
+            if act is None:
+                continue
+            self.slots[idx] = None
+            self.engine.free_slot(idx)
+            act.prefill_task = None
+            _carry(act.req, act, act.queue_wait)
+        for rid, f in list(self._fetches.items()):
+            del self._fetches[rid]
+            f.span.end(aborted=True, error="exported", pages=f.pages_in)
+            _carry(f.req, None, None)
+        for req in list(self.waiting):
+            parked = self._preempted.pop(req.rid, None)
+            _carry(req, parked,
+                   parked.queue_wait if parked is not None else None)
+        self.waiting.clear()
+        self._m_queue_depth.set(0)
+        self._m_occupancy.set(0)
+        return out
 
     def request_span(self, rid: int):
         """The live root span of an unfinished request (the front-end

@@ -41,6 +41,7 @@ REQUIRED_SITES = {
     "checkpoint.shard_write", "checkpoint.shard_file", "checkpoint.publish",
     "checkpoint.restore_read", "train.epoch", "train.grads",
     "amp.found_inf", "store.client_op", "launch.respawn",
+    "serve.replica",
 }
 
 
@@ -66,6 +67,7 @@ def test_registry_covers_instrumented_stack():
     # (store/launch via paddle_tpu.distributed)
     import paddle_tpu.distributed.launch_main  # noqa: F401
     import paddle_tpu.distributed.store  # noqa: F401
+    import paddle_tpu.serving.router  # noqa: F401
     assert REQUIRED_SITES <= set(fp.SITES), \
         REQUIRED_SITES - set(fp.SITES)
 

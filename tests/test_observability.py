@@ -283,6 +283,39 @@ def test_catalog_coverage_is_two_way(monkeypatch, tmp_path):
     finally:
         fe.stop()
 
+    # -- serving F: replicated fleet (ISSUE 19) — two replicas behind the
+    # router, one killed mid-drive at the serve.replica site so every
+    # fleet metric fires for real: routed{reason} on admission,
+    # failovers on the crash requeue, replicas_healthy on the shrink
+    import threading as _threading
+
+    from paddle_tpu.robustness.faultpoints import HardExit
+    from paddle_tpu.serving.router import Router
+    fleet = [DecodeEngine(model, num_slots=2, max_len=64, seed=0,
+                          page_size=8) for _ in range(2)]
+    router = Router(fleet, probe_interval=None, respawn_delay=30.0)
+    fin = {"n": 0}
+    fleet_done = _threading.Event()
+
+    def _fleet_finish(res):
+        fin["n"] += 1
+        if fin["n"] == 3:
+            fleet_done.set()
+    router.on_finish = _fleet_finish
+    router.start()
+    try:
+        plan = FaultPlan(seed=0).inject("serve.replica", HardExit(), at=4)
+        with chaos(plan):
+            for _ in range(3):
+                router.submit(Request(
+                    prompt=rng.integers(0, cfg.vocab_size, (8,)),
+                    max_new_tokens=4, temperature=0.0))
+            assert fleet_done.wait(60), "fleet drive did not finish"
+        plan.assert_all_fired()
+        assert obs.counter("router.failovers").value >= 1
+    finally:
+        router.stop()
+
     # -- training: TrainStep (+ opt-in grad norm) and the hapi fit loop ----
     from paddle_tpu import hapi, nn
     from paddle_tpu.jit import TrainStep
@@ -906,7 +939,7 @@ def test_bench_schema_rejects_malformed_lines():
 def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
                 metric="decode_tokens_per_sec", layout="paged",
                 kv_dtype=None, spec=None, kv_host=None, repeat_ttft=None,
-                host_hit_pages=None):
+                host_hit_pages=None, replicas=None):
     line = {"metric": metric, "value": value, "unit": "tok/s",
             "cache_layout": layout,
             "compile_counts": {"decode": decode_compiles, "prefill": 1},
@@ -926,6 +959,8 @@ def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
         line["repeat_ttft_ms"] = repeat_ttft
     if host_hit_pages is not None:
         line["host_hit_pages"] = host_hit_pages
+    if replicas is not None:
+        line["replicas"] = replicas
     p = tmp_path / name
     p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
                              "parsed": line}))
@@ -1084,6 +1119,48 @@ def test_trajectory_kv_host_cursor_and_repeat_ttft_gate(tmp_path):
         bs.validate_line({"metric": "decode_tokens_per_sec",
                           "value": 1.0, "unit": "tok/s",
                           "kv_host": True}, "<line>")
+
+
+def test_trajectory_replicas_cursor_and_fleet_compile_budget(tmp_path):
+    """ISSUE-19 fleet axis: --replicas N lines key their OWN regression
+    cursor (a per-replica goodput number paces differently than the
+    single-engine line — that is the A/B, not a regression) while
+    legacy lines without the field keep theirs; and the compile-once
+    gate scales to once PER REPLICA on fleet lines only — a summed
+    count of N over N replicas is the contract, the same count on a
+    single-engine line is a retrace."""
+    bs = _bench_schema()
+    # a 2-replica line slower than the legacy single-engine anchor it
+    # follows: different legs, no fail — and the next legacy line still
+    # gates against ITS cursor, not the fleet line in between
+    mixed = [
+        _traj_entry(tmp_path, "BENCH_decode_r61.json", 1000.0, "tpu"),
+        _traj_entry(tmp_path, "BENCH_decode_r62.json", 600.0, "tpu",
+                    replicas=2, decode_compiles=2),
+        _traj_entry(tmp_path, "BENCH_decode_r63.json", 995.0, "tpu"),
+    ]
+    assert bs.check_trajectory(mixed) == []
+    # a second fleet round regressing on the replicas=2 leg fails,
+    # anchored to the last FLEET entry — not the legacy line between
+    mixed.append(_traj_entry(tmp_path, "BENCH_decode_r64.json", 400.0,
+                             "tpu", replicas=2, decode_compiles=2))
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "regression" in fails[0]
+    assert "BENCH_decode_r64" in fails[0] and "BENCH_decode_r62" in fails[0]
+    # compile-once scales with the fleet: 2 compiles over 2 replicas
+    # passes (asserted by the healthy series above), the SAME count on
+    # a line without the field is a retrace and fails
+    bad = [_traj_entry(tmp_path, "BENCH_decode_r71.json", 50.0, "cpu",
+                       decode_compiles=2)]
+    fails = bs.check_trajectory(bad)
+    assert fails and all("compile-once" in f for f in fails)
+    # and a fleet line under-compiling (one cold replica never drove its
+    # decode program) fails too — once per replica, no more, no less
+    cold = [_traj_entry(tmp_path, "BENCH_decode_r72.json", 50.0, "cpu",
+                        replicas=2, decode_compiles=1)]
+    fails = bs.check_trajectory(cold)
+    assert fails and all("compile-once" in f for f in fails)
+    assert "2 replica" in fails[0]
 
 
 def test_trajectory_mode_accepts_committed_repo_files():

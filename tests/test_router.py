@@ -1,0 +1,650 @@
+"""Replicated serving fleet (serving/router.py — ISSUE 19).
+
+The router tier over N in-process scheduler+engine replicas: the
+prefix-affinity routing ladder (index hit routes to the owner; a stale
+view degrades to least-loaded, never errors; a stalled-but-alive
+replica is routed around), and the headline failover protocol — a
+replica death mid-stream (HardExit crash contained by the faultpoints
+crash scope, or a Hang the health probe trips on) requeues its
+in-flight requests onto survivors through the recompute-preemption
+path: partial tokens re-prefill, greedy output stays bit-identical to
+an undisturbed run, requeues respect the ``max_requeues`` bound, the
+dead replica respawns under the launcher backoff discipline and
+rejoins after a healthy interval — with every surviving replica's
+compile counts still exactly 1 per watched entry.
+"""
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.robustness import faultpoints as fp
+from paddle_tpu.serving.engine import DecodeEngine
+from paddle_tpu.serving.frontend import ServingFrontend
+from paddle_tpu.serving.pages import prompt_digest_chain
+from paddle_tpu.serving.router import (NoHealthyReplicas,
+                                       RemoteReplicaHandle, Router)
+from paddle_tpu.serving.scheduler import Request
+
+PROMPTS = [[1, 2, 3, 4], [5, 6, 7, 8], [2, 3, 4, 5], [7, 8, 9, 10]]
+MAX_NEW = 12
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = cfg.attention_dropout_prob = 0.0
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def engines(model):
+    # THREE engines for the whole module (fleets of 1-2 plus a baseline
+    # arm): routers come and go, the compiled programs persist
+    return [DecodeEngine(model, num_slots=2, max_len=64, seed=0,
+                         page_size=8) for _ in range(3)]
+
+
+def _drive(router, prompts, max_new=MAX_NEW, timeout=90.0):
+    """Submit ``prompts`` greedily through a STARTED router and block
+    until every one finished; returns (tokens-by-prompt-index,
+    results-by-prompt-index)."""
+    lock = threading.Lock()
+    toks, results = {}, {}
+    done = threading.Event()
+
+    def on_token(rid, t):
+        with lock:
+            toks.setdefault(rid, []).extend(int(x) for x in t)
+
+    def on_finish(res):
+        with lock:
+            results[res.rid] = res
+            if len(results) == len(prompts):
+                done.set()
+
+    router.on_token = on_token
+    router.on_finish = on_finish
+    rids = [router.submit(Request(prompt=np.asarray(p, np.int32),
+                                  max_new_tokens=max_new,
+                                  temperature=0.0))
+            for p in prompts]
+    assert done.wait(timeout), "fleet did not finish %d requests" \
+        % len(prompts)
+    return ({i: toks.get(rid, []) for i, rid in enumerate(rids)},
+            {i: results[rid] for i, rid in enumerate(rids)})
+
+
+@pytest.fixture(scope="module")
+def baseline(engines):
+    """Undisturbed greedy outputs for PROMPTS through a single-replica
+    fleet — the bit-identity reference every failover test compares
+    against."""
+    engines[2].reset()
+    router = Router([engines[2]], probe_interval=None).start()
+    try:
+        toks, results = _drive(router, PROMPTS)
+    finally:
+        router.stop()
+    assert all(r.finish_reason == "length" for r in results.values())
+    assert all(len(t) == MAX_NEW for t in toks.values())
+    return toks
+
+
+# ==========================================================================
+# crash scope + registry + digest chain (fast units)
+# ==========================================================================
+
+def test_crash_scope_contains_hardexit():
+    """Inside ``crash_scope`` a HardExit raises CrashScopeExit (rc
+    preserved) instead of killing the process — the containment that
+    lets a replica thread die like a process."""
+    act = fp.HardExit(rc=7)
+    with pytest.raises(fp.CrashScopeExit) as ei:
+        with fp.crash_scope():
+            act.fire({}, fp.FaultPlan())
+    assert ei.value.rc == 7
+    # CrashScopeExit is a BaseException: ordinary `except Exception`
+    # recovery code cannot swallow a simulated process death
+    assert not isinstance(ei.value, Exception)
+
+
+def test_replica_site_declared():
+    """Importing the router registers its chaos site (the registry
+    mirrors the instrumentation, ROBUSTNESS.md discipline)."""
+    import paddle_tpu.serving.router  # noqa: F401
+    assert "serve.replica" in fp.SITES
+
+
+def test_router_metrics_catalogd():
+    obs.counter("router.routed", ("reason",))
+    obs.gauge("router.replicas_healthy")
+    obs.counter("router.failovers")
+
+
+def test_prompt_digest_chain_prefix_property():
+    ids = np.arange(1, 33, dtype=np.int32)
+    chain = prompt_digest_chain(ids, 8)
+    assert len(chain) == 4             # full pages only; tail omitted
+    assert prompt_digest_chain(ids[:16], 8) == chain[:2]
+    # a different first page changes EVERY later digest (chained)
+    other = prompt_digest_chain(np.r_[ids[:7], 99, ids[8:]], 8)
+    assert all(a != b for a, b in zip(chain, other))
+    assert prompt_digest_chain(ids[:7], 8) == []    # < one page
+
+
+def test_remote_handle_is_routing_view_only():
+    h = RemoteReplicaHandle(1, store=None, world_size=2)
+    assert h.state == "remote"
+    with pytest.raises(NotImplementedError):
+        h.enqueue_submit(None, None)
+    with pytest.raises(NotImplementedError):
+        h.enqueue_transfer(None, None)
+    with pytest.raises(NotImplementedError):
+        h.enqueue_cancel(None, None)
+
+
+# ==========================================================================
+# routing ladder
+# ==========================================================================
+
+@pytest.mark.slow
+def test_unstarted_fleet_routes_nothing(engines):
+    router = Router(engines[:2], probe_interval=None)
+    with pytest.raises(NoHealthyReplicas):
+        router._route(np.arange(1, 9, dtype=np.int32))
+    with pytest.raises(NoHealthyReplicas):
+        router.submit(Request(prompt=np.arange(1, 9, dtype=np.int32),
+                              max_new_tokens=4, temperature=0.0))
+
+
+@pytest.mark.slow
+def test_submit_validates_before_routing(engines):
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None).start()
+    try:
+        with pytest.raises(ValueError):
+            router.submit(Request(prompt=np.asarray([], np.int32),
+                                  max_new_tokens=4))
+        with pytest.raises(ValueError):
+            router.submit(Request(prompt=np.arange(1000, dtype=np.int32),
+                                  max_new_tokens=4))
+        with pytest.raises(ValueError):
+            router.submit(Request(prompt=np.arange(1, 5, dtype=np.int32),
+                                  max_new_tokens=0))
+        assert router.flights() == 0    # nothing leaked into the table
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_affinity_routes_to_cache_owner(engines):
+    """After one replica served a prompt, its pages advertise the
+    prompt's digest chain through the probe-refreshed view — the SAME
+    prefix routes back to that owner with reason 'affinity'."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None).start()
+    try:
+        warm = np.arange(1, 17, dtype=np.int32)     # two full pages
+        _drive(router, [warm], max_new=4)
+        router.probe_once()                          # refresh views
+        chain0 = prompt_digest_chain(warm, 8)[0]
+        from paddle_tpu.serving.kv_tier import _hex
+        owners = [i for i, e in enumerate(engines[:2])
+                  if _hex(chain0) in e.prefix_digest_snapshot()]
+        assert len(owners) == 1         # exactly one replica owns it
+        r, reason = router._route(warm)
+        assert reason == "affinity" and r.idx == owners[0]
+        # a LONGER prompt sharing the prefix still routes to the owner
+        r, reason = router._route(np.r_[warm, 40, 41, 42])
+        assert reason == "affinity" and r.idx == owners[0]
+        # an unrelated prompt makes no affinity claim
+        _, reason = router._route(np.arange(100, 116, dtype=np.int32))
+        assert reason == "least_loaded"
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_stale_view_degrades_to_least_loaded(engines):
+    """A stale digest view makes no affinity claim and must DEGRADE the
+    decision, never error — the cluster-index staleness contract."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None,
+                    snapshot_ttl=0.5).start()
+    try:
+        warm = np.arange(1, 17, dtype=np.int32)
+        _drive(router, [warm], max_new=4)
+        router.probe_once()
+        assert router._route(warm)[1] == "affinity"
+        # age ONLY the digest views: affinity silently drops out while
+        # the fresh snapshots keep least-loaded alive
+        for r in router.replicas:
+            r.view_ts = time.monotonic() - 99.0
+        target, reason = router._route(warm)
+        assert reason == "least_loaded" and target.state == "healthy"
+        # age the snapshots too (total telemetry blackout): round-robin
+        # keeps admitting rather than shedding live replicas
+        for r in router.replicas:
+            r.snap_ts = time.monotonic() - 99.0
+        seen = {router._route(warm)[0].idx for _ in range(4)}
+        assert seen == {0, 1}           # blackout round-robin rotates
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_stalling_replica_routed_around_before_death(engines):
+    """A busy replica whose step beacon is aging past
+    ``route_around_after`` loses least-loaded eligibility — routed
+    AROUND while not yet declared dead."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None,
+                    stall_deadline=30.0).start()
+    try:
+        now = time.monotonic()
+        r0, r1 = router.replicas
+        r0.snap = {"queue_depth": 3, "slots_active": 2, "busy": True,
+                   "beacon_age_s": 20.0}     # > stall_deadline / 2
+        r0.snap_ts = now
+        r1.snap = {"queue_depth": 5, "slots_active": 2,
+                   "beacon_age_s": 0.0}
+        r1.snap_ts = now
+        # r1 is LOADED heavier, but r0's aging beacon disqualifies it
+        target, reason = router._route(np.arange(1, 9, dtype=np.int32))
+        assert reason == "least_loaded" and target.idx == 1
+        assert router.replica_states() == ["healthy", "healthy"]
+    finally:
+        router.stop()
+
+
+# ==========================================================================
+# failover: crash (HardExit)
+# ==========================================================================
+
+@pytest.mark.slow
+def test_hardexit_failover_bit_identical(engines, baseline):
+    """THE headline: a replica crashes mid-stream; every in-flight
+    request requeues onto the survivor, resumes from its partial
+    tokens, and finishes with greedy output bit-identical to an
+    undisturbed run — then the dead replica respawns and rejoins."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], respawn_delay=0.05,
+                    healthy_interval=0.2, probe_interval=0.05).start()
+    f0 = obs.counter("router.failovers").value
+    try:
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", fp.HardExit(), at=6)
+        with fp.chaos(plan):
+            toks, results = _drive(router, PROMPTS)
+        plan.assert_all_fired()
+        assert obs.counter("router.failovers").value == f0 + 1
+        for i in range(len(PROMPTS)):
+            assert results[i].finish_reason == "length"
+            assert toks[i] == baseline[i], \
+                "prompt %d diverged after failover" % i
+            assert [int(t) for t in results[i].tokens] == baseline[i]
+        # launcher discipline: the dead replica respawns, rejoins after
+        # a healthy interval, and the fleet is whole again
+        deadline = time.monotonic() + 10
+        while (router.healthy_count() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert router.replica_states() == ["healthy", "healthy"]
+        # compile-once per surviving replica: the respawn reused the
+        # engine, so nothing recompiled anywhere in the fleet
+        for e in engines[:2]:
+            assert e.flight_state()["compile_counts"]["decode"] == 1
+    finally:
+        router.stop()
+
+
+class _KillWhen(fp.HardExit):
+    """HardExit gated on a scheduler-state predicate — picks the crash
+    MOMENT (victim mid-prefill vs mid-decode) instead of a hit index.
+    Injected with ``every=1`` so the predicate sees every iteration;
+    the lock makes sure only ONE replica dies."""
+
+    def __init__(self, pred):
+        super().__init__()
+        self.pred = pred
+        self.killed = False
+        self._lk = threading.Lock()
+
+    def fire(self, ctx, plan):
+        with self._lk:
+            if self.killed or not self.pred(ctx["scheduler"]):
+                return
+            self.killed = True
+        super().fire(ctx, plan)
+
+
+def _victim_mid_prefill(sched):
+    # the victim is still WAITING: killed before admission, so failover
+    # re-admits it through the fresh-admission path (no partial tokens)
+    return len(sched.waiting) > 0
+
+
+def _victim_mid_decode(sched):
+    # a slot holds >= 2 generated tokens: failover must re-prefill
+    # prompt + partials through the recompute path
+    return any(a is not None and len(a.generated) >= 2
+               for a in sched.slots)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pred", [_victim_mid_prefill,
+                                  _victim_mid_decode],
+                         ids=["mid_prefill", "mid_decode"])
+def test_kill_victim_by_phase_bit_identical(engines, baseline, pred):
+    """Crash timing chosen by scheduler STATE: whether the victim dies
+    before admission or deep into decode, the stream resumes and greedy
+    output stays bit-identical."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], respawn_delay=0.05,
+                    healthy_interval=0.2, probe_interval=0.05).start()
+    try:
+        act = _KillWhen(pred)
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", act, every=1)
+        with fp.chaos(plan):
+            toks, results = _drive(router, PROMPTS)
+        assert act.killed, "the predicate never found its victim phase"
+        for i in range(len(PROMPTS)):
+            assert results[i].finish_reason == "length"
+            assert toks[i] == baseline[i]
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_double_kill_respects_requeue_budget(engines, baseline):
+    """Two kills in one drive (the second can orphan already-failed-
+    over flights): with a sane budget everything still finishes
+    bit-identically, and failovers counts both deaths."""
+    for e in engines[:3]:
+        e.reset()
+    router = Router(engines[:3], respawn_delay=0.05,
+                    healthy_interval=0.2, probe_interval=0.05,
+                    max_requeues=3).start()
+    f0 = obs.counter("router.failovers").value
+    try:
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", fp.HardExit(), at=6)
+        plan.inject("serve.replica", fp.HardExit(), at=40)
+        with fp.chaos(plan):
+            toks, results = _drive(router, PROMPTS)
+        plan.assert_all_fired()
+        assert obs.counter("router.failovers").value == f0 + 2
+        for i in range(len(PROMPTS)):
+            assert results[i].finish_reason == "length"
+            assert toks[i] == baseline[i]
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_requeue_budget_exhaustion_finishes_failover_limit(engines):
+    """``max_requeues=0``: a crash victim cannot requeue — it finishes
+    ``"failover_limit"`` with its delivered partial tokens, a CLOSED
+    stream with a reason, never a silent drop."""
+    engines[0].reset()
+    router = Router([engines[0]], probe_interval=None,
+                    max_requeues=0).start()
+    try:
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", fp.HardExit(), at=6)
+        with fp.chaos(plan):
+            toks, results = _drive(router, PROMPTS, timeout=30.0)
+        plan.assert_all_fired()
+        reasons = {results[i].finish_reason
+                   for i in range(len(PROMPTS))}
+        assert "failover_limit" in reasons
+        assert reasons <= {"failover_limit", "length"}
+    finally:
+        router.stop()
+
+
+# ==========================================================================
+# failover: hang (probe-tripped) + zombie fencing
+# ==========================================================================
+
+@pytest.mark.slow
+def test_hang_failover_probe_trips_and_zombie_is_fenced(engines,
+                                                        baseline):
+    """A wedged (not crashed) replica: the health probe trips on the
+    aging step beacon, fails the streams over, and the zombie thread —
+    waking AFTER being declared dead — sees the bumped epoch and exits
+    without touching the replacement scheduler."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None,
+                    stall_deadline=0.4, respawn_delay=0.05,
+                    healthy_interval=0.2).start()
+    f0 = obs.counter("router.failovers").value
+    try:
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", fp.Hang(1.5), at=6)
+        with fp.chaos(plan):
+            lock = threading.Lock()
+            toks, results = {}, {}
+            done = threading.Event()
+
+            def on_token(rid, t):
+                with lock:
+                    toks.setdefault(rid, []).extend(int(x) for x in t)
+
+            def on_finish(res):
+                with lock:
+                    results[res.rid] = res
+                    if len(results) == len(PROMPTS):
+                        done.set()
+
+            router.on_token = on_token
+            router.on_finish = on_finish
+            rids = [router.submit(Request(
+                prompt=np.asarray(p, np.int32),
+                max_new_tokens=MAX_NEW, temperature=0.0))
+                for p in PROMPTS]
+            # drive the probe OURSELVES (probe_interval=None): it must
+            # trip the stalled beacon while the hang is still holding
+            deadline = time.monotonic() + 30
+            while not done.is_set() and time.monotonic() < deadline:
+                router.probe_once()
+                time.sleep(0.05)
+            assert done.is_set()
+        plan.assert_all_fired()
+        assert obs.counter("router.failovers").value == f0 + 1
+        for i, rid in enumerate(rids):
+            assert results[rid].finish_reason == "length"
+            assert toks[rid] == baseline[i]
+        # let the zombie wake into its fenced epoch, then verify the
+        # replacement is healthy and stepping
+        time.sleep(1.2)
+        deadline = time.monotonic() + 10
+        while (router.healthy_count() < 2
+               and time.monotonic() < deadline):
+            router.probe_once()
+            time.sleep(0.05)
+        assert router.replica_states() == ["healthy", "healthy"]
+        _drive(router, [[3, 1, 4, 1]], max_new=4)   # fleet still serves
+    finally:
+        router.stop()
+
+
+# ==========================================================================
+# graceful decommission (export/import requeue)
+# ==========================================================================
+
+@pytest.mark.slow
+def test_decommission_exports_streams_to_survivor(engines, baseline):
+    """Graceful retirement: the replica drains its scheduler through
+    export_requeue_state on its own thread; every unfinished request
+    resumes on the survivor bit-identically and the retiree leaves the
+    routable set permanently."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None).start()
+    try:
+        lock = threading.Lock()
+        toks, results = {}, {}
+        done = threading.Event()
+        first = threading.Event()
+
+        def on_token(rid, t):
+            with lock:
+                toks.setdefault(rid, []).extend(int(x) for x in t)
+            first.set()
+
+        def on_finish(res):
+            with lock:
+                results[res.rid] = res
+                if len(results) == len(PROMPTS):
+                    done.set()
+
+        router.on_token = on_token
+        router.on_finish = on_finish
+        rids = [router.submit(Request(prompt=np.asarray(p, np.int32),
+                                      max_new_tokens=MAX_NEW,
+                                      temperature=0.0))
+                for p in PROMPTS]
+        assert first.wait(30)           # streams are live
+        with router._lock:
+            owners = {fl.replica for fl in router._flights.values()}
+        victim = min(owners)            # retire a replica with flights
+        router.decommission(victim)
+        assert done.wait(60)
+        for i, rid in enumerate(rids):
+            assert results[rid].finish_reason == "length"
+            assert toks[rid] == baseline[i]
+        states = router.replica_states()
+        assert states[victim] == "stopped"
+        assert "healthy" in states      # the survivor still routes
+        _drive(router, [[9, 9, 9, 9]], max_new=4)
+    finally:
+        router.stop()
+
+
+# ==========================================================================
+# cancel + fleet front-end over HTTP
+# ==========================================================================
+
+@pytest.mark.slow
+def test_cancel_during_failover_finishes_cancelled(engines):
+    """A rid whose client cancelled right around the crash must come
+    back ``"cancelled"``, not resume on the survivor."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], probe_interval=None,
+                    max_requeues=0).start()
+    try:
+        results = {}
+        done = threading.Event()
+
+        def on_finish(res):
+            results[res.rid] = res
+            done.set()
+
+        router.on_finish = on_finish
+        rid = router.submit(Request(
+            prompt=np.asarray(PROMPTS[0], np.int32),
+            max_new_tokens=MAX_NEW, temperature=0.0))
+        assert router.cancel(rid) is True
+        assert done.wait(30)
+        assert results[rid].finish_reason == "cancelled"
+        assert router.cancel(rid) is False      # unknown rid now
+    finally:
+        router.stop()
+
+
+def _fleet_post(host, port, payload):
+    s = socket.create_connection((host, port), timeout=60)
+    body = json.dumps(payload).encode()
+    s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+              b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    buf = b""
+    while True:
+        b = s.recv(65536)
+        if not b:
+            break
+        buf += b
+    s.close()
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    events = [json.loads(l[6:]) for l in rest.split(b"\n\n")
+              if l.startswith(b"data: ")]
+    return status, events
+
+
+@pytest.mark.slow
+def test_fleet_frontend_kill_mid_drive_drops_no_stream(engines,
+                                                       baseline):
+    """The HTTP surface of the headline: SSE streams ride through a
+    replica kill — every accepted stream completes (zero drops), the
+    delivered tokens are bit-identical, and /healthz exposes the fleet
+    (a respawn in flight is visible to an external probe)."""
+    for e in engines[:2]:
+        e.reset()
+    router = Router(engines[:2], respawn_delay=0.05,
+                    healthy_interval=0.2, probe_interval=0.05)
+    fe = ServingFrontend(router=router, queue_limit=16)
+    host, port = fe.start()
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n")
+        buf = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+        s.close()
+        doc = json.loads(buf.partition(b"\r\n\r\n")[2])
+        assert doc["replicas_healthy"] == 2
+        assert doc["replicas"] == ["healthy", "healthy"]
+
+        plan = fp.FaultPlan()
+        plan.inject("serve.replica", fp.HardExit(), at=8)
+        outs = [None] * len(PROMPTS)
+
+        def drive(i):
+            outs[i] = _fleet_post(host, port, {
+                "prompt": PROMPTS[i], "max_new_tokens": MAX_NEW,
+                "temperature": 0.0})
+
+        with fp.chaos(plan):
+            ths = [threading.Thread(target=drive, args=(i,))
+                   for i in range(len(PROMPTS))]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(60)
+        plan.assert_all_fired()
+        for i, (status, events) in enumerate(outs):
+            assert status == 200
+            dones = [e for e in events if e.get("done")]
+            assert len(dones) == 1
+            assert dones[0]["finish_reason"] == "length"
+            got = [t for e in events if "tokens" in e
+                   and not e.get("done") for t in e["tokens"]]
+            assert got == baseline[i], \
+                "stream %d diverged through the kill" % i
+        fe.drain()
+        assert fe.wait_drained(10)
+    finally:
+        fe.stop()

@@ -58,15 +58,16 @@ filename), and these gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec, tp, overlap, kv_host, disagg, qps, mix)`` cursor key
-  matches (the ISSUE-8 A/B matrix interleaves quantized/speculative
-  lines in one trajectory, ISSUE 12 adds the ``--tp`` axis, ISSUE 13
-  adds the sync-vs-overlapped loop axis plus the serve harness's (QPS,
-  mix) operating points, ISSUE 15 adds the colocated-vs-disaggregated
-  axis, and ISSUE 17 adds the ``--kv-host`` tier axis — a tp=2,
-  sync-loop, disagg, kv-host-on, or qps=16 line must never gate
-  against a different series; legacy lines without a field keep their
-  own ``None``-keyed cursor, regression-tested), a >3% drop in
+  kv_dtype, spec, tp, overlap, kv_host, disagg, qps, mix, replicas)``
+  cursor key matches (the ISSUE-8 A/B matrix interleaves
+  quantized/speculative lines in one trajectory, ISSUE 12 adds the
+  ``--tp`` axis, ISSUE 13 adds the sync-vs-overlapped loop axis plus
+  the serve harness's (QPS, mix) operating points, ISSUE 15 adds the
+  colocated-vs-disaggregated axis, ISSUE 17 adds the ``--kv-host``
+  tier axis, and ISSUE 19 adds the ``--replicas`` fleet axis — a tp=2,
+  sync-loop, disagg, kv-host-on, qps=16, or 2-replica line must never
+  gate against a different series; legacy lines without a field keep
+  their own ``None``-keyed cursor, regression-tested), a >3% drop in
   ``value`` fails.  CPU entries never perf-gate (smoke numbers), so
   the gate arms itself automatically the first session that records
   chip numbers;
@@ -224,6 +225,21 @@ def validate_serve_fields(doc: Any, path: str):
                      and doc["handoff_bytes"] >= 0, path,
                      "a disagg serve line must report non-negative "
                      "'handoff_bytes'")
+    # ISSUE-19 optional fields: absent on pre-fleet lines (their own
+    # legacy cursor — a replicated line must never gate against
+    # single-replica history), validated whenever present
+    if "replicas" in doc:
+        _require(isinstance(doc["replicas"], int)
+                 and not isinstance(doc["replicas"], bool)
+                 and doc["replicas"] >= 1, path,
+                 "serve line 'replicas' must be an int >= 1, got %r"
+                 % (doc["replicas"],))
+    if "dropped_streams" in doc:
+        _require(isinstance(doc["dropped_streams"], int)
+                 and not isinstance(doc["dropped_streams"], bool)
+                 and doc["dropped_streams"] >= 0, path,
+                 "serve line 'dropped_streams' must be a non-negative "
+                 "int, got %r" % (doc["dropped_streams"],))
     if "wave" in doc:
         w = doc["wave"]
         _require(isinstance(w, dict), path, "'wave' must be an object")
@@ -299,9 +315,17 @@ def validate_line(doc: Any, path: str,
         _require("metrics" in doc, path,
                  "--expect-compile-once needs the metrics block")
         got = doc["metrics"]["compile_counts"].get(entry)
-        _require(got == 1, path,
+        # a replicated-fleet line (ISSUE 19) sums same-name entries over
+        # its N live engines: compile-once there means exactly N — one
+        # per replica, zero respawn recompiles
+        want = (doc["replicas"]
+                if isinstance(doc.get("replicas"), int)
+                and not isinstance(doc.get("replicas"), bool)
+                and doc["replicas"] >= 1 else 1)
+        _require(got == want, path,
                  "watchdog reports compile_counts[%r] = %r, expected "
-                 "exactly 1 (compile-once contract)" % (entry, got))
+                 "exactly %d (compile-once contract, %d replica(s))"
+                 % (entry, got, want, want))
 
 
 def validate_wrapper(doc: Any, path: str,
@@ -418,6 +442,10 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "disagg": line.get("disagg"),
             "qps": line.get("qps"),
             "mix": line.get("mix"),
+            # ISSUE-19 fleet axis: None on pre-fleet lines keys their
+            # own legacy cursor (regression-tested) — a 2-replica
+            # goodput number never gates against a 1-replica anchor
+            "replicas": line.get("replicas"),
             "ttft_p99_ms": line.get("ttft_p99_ms"),
             "repeat_ttft_ms": line.get("repeat_ttft_ms"),
             "compile_counts": (line.get("metrics", {}) or {}).get(
@@ -434,11 +462,17 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                   if kind == "metrics" else line.get("compile_counts"))
             if cc is None or key not in cc:
                 continue
-            if cc[key] != 1:
+            # fleet lines (ISSUE 19) sum same-name watchdog entries
+            # over N live engines: once-per-replica is the contract
+            want = (entry["replicas"]
+                    if isinstance(entry.get("replicas"), int)
+                    and not isinstance(entry.get("replicas"), bool)
+                    and entry["replicas"] >= 1 else 1)
+            if cc[key] != want:
                 failures.append(
                     "%s: compile-once violated — %s compile count for "
-                    "%r is %r, expected exactly 1" % (p, kind, key,
-                                                      cc[key]))
+                    "%r is %r, expected exactly %d (%d replica(s))"
+                    % (p, kind, key, cc[key], want, want))
 
     # gate 2 — on-chip regression between consecutive chip entries.
     # One cursor per (model, cache_layout, kv_dtype, spec, tp) within
@@ -465,7 +499,7 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             key = (e.get("model"), e.get("cache_layout"),
                    e.get("kv_dtype"), e.get("spec"), e.get("tp"),
                    e.get("overlap"), e.get("kv_host"), e.get("disagg"),
-                   e.get("qps"), e.get("mix"))
+                   e.get("qps"), e.get("mix"), e.get("replicas"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
                     and _is_num(prev["value"]) and prev["value"] > 0):
