@@ -30,10 +30,13 @@ below runs as one matrix, one JSON line each):
   reports `kv_bytes_per_token` {paged: mapped-rows bound, flat: the
   slotted slots*max_len bound}; a third of the workload reuses one
   shared prompt so prefix sharing/CoW stay on the timed path.
-* `--kv-dtype bf16|int8` (comma list for a sweep) — int8 stores the KV
-  pool as int8 codes + per-(row, head) f32 scales, HALVING the decode
-  read bound at head_dim 64 ((64+4)/(2*64) = 0.53x the bf16 row — the
-  acceptance line; the accounting charges the scale reads honestly).
+* `--kv-dtype bf16|int8|fp8` (comma list for a sweep) — int8 stores the
+  KV pool as int8 codes + per-(row, head) f32 scales, HALVING the
+  decode read bound at head_dim 64 ((64+4)/(2*64) = 0.53x the bf16 row
+  — the acceptance line; the accounting charges the scale reads
+  honestly).  fp8 (ISSUE 20) keeps the SAME 1-byte row and scale
+  accounting with float8_e4m3fn codes — a dtype the MXU multiplies
+  natively, trading int8's rounding grid for hardware-matmul codes.
 * `--spec k|off` (comma list) — self-speculative decode: k prompt-lookup
   drafts per slot per iteration, one batched verify program.  Emits
   `accepted_tokens_per_step` (accepted drafts per verify iteration,
@@ -52,6 +55,15 @@ below runs as one matrix, one JSON line each):
   XLA_FLAGS=--xla_force_host_platform_device_count).  `tp` is a
   trajectory cursor field: tp=1 and tp=2 series never gate against
   each other.
+* `--overlap-comm on|off` (comma list, ISSUE 20; tp>1 only) — the
+  decomposed collective-matmul rings: the sharded decode program's
+  monolithic all-gather/all-reduce islands become chunked
+  collective-permute rings interleaved with the partial matmuls, so
+  transfer hides behind compute.  When BOTH arms run one tp=2
+  configuration, greedy output is asserted bit-identical (a two-term
+  f32 sum commutes with GSPMD's reduction; wider meshes re-associate,
+  so tp>2 pairs only report).  `overlap_comm` is a trajectory cursor
+  field: the ring and monolithic series never gate against each other.
 * `--kv-host on|off` (comma list, ISSUE 17) — the host-RAM KV page
   tier.  Every paged line appends a repeat-prompt phase (device prefix
   cache forced cold, the shared prompt re-admitted through one fresh
@@ -81,7 +93,7 @@ import numpy as np
 
 def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
                overlap: bool = True, trace_file: str = None,
-               kv_host: bool = False):
+               kv_host: bool = False, overlap_comm: bool = False):
     import jax
 
     import paddle_tpu as paddle
@@ -132,9 +144,14 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
     tracer = _tracing.Tracer() if trace_file else None
     engine = DecodeEngine(model, num_slots=num_slots, max_len=max_len,
                           seed=0, paged=paged, page_size=page_size,
-                          kv_dtype=("int8" if kv_dtype == "int8"
+                          kv_dtype=(kv_dtype if kv_dtype in ("int8",
+                                                             "fp8")
                                     else None),
                           spec_k=spec, tracer=tracer, tp=tp,
+                          # ISSUE 20: an explicit bool pins the ring
+                          # on/off regardless of PADDLE_TPU_MP_OVERLAP,
+                          # so the off arm is a true monolithic baseline
+                          overlap_comm=overlap_comm,
                           # tiered KV A/B (ISSUE 17): 0 pins the tier OFF
                           # regardless of PADDLE_TPU_KV_HOST_BYTES so the
                           # off arm is a true baseline
@@ -227,6 +244,7 @@ def run_config(paged: bool, kv_dtype: str, spec: int, tp: int = 1,
         "spec": spec,
         "tp": tp,
         "overlap": overlap,
+        "overlap_comm": "on" if overlap_comm else "off",
         "kv_host": "on" if kv_host else "off",
         "host_gap_ms_per_step": round(host_gap_ms, 4),
         # the ISSUE-7/8/12 acceptance line: decode KV bytes read per
@@ -367,8 +385,10 @@ def main(argv=None):
     ap.add_argument("--both", action="store_true",
                     help="paged AND slotted lines")
     ap.add_argument("--kv-dtype", default="bf16",
-                    help="comma list of bf16|int8 (bf16 = the "
-                         "unquantized pool at the activation dtype)")
+                    help="comma list of bf16|int8|fp8 (bf16 = the "
+                         "unquantized pool at the activation dtype; "
+                         "fp8 = float8_e4m3fn codes on the int8 "
+                         "codes+scales plumbing)")
     ap.add_argument("--spec", default="off",
                     help="comma list of off|<k>: speculative draft "
                          "length per iteration (paged only)")
@@ -384,6 +404,12 @@ def main(argv=None):
                          "configuration, greedy output is asserted "
                          "bit-identical and the overlapped host-gap/"
                          "step must not exceed the sync one")
+    ap.add_argument("--overlap-comm", default="off",
+                    help="comma list of on|off: decomposed "
+                         "collective-matmul rings in the tp-sharded "
+                         "programs (ISSUE 20; tp>1 only).  When both "
+                         "arms run one tp=2 configuration, greedy "
+                         "output is asserted bit-identical")
     ap.add_argument("--kv-host", default="off",
                     help="comma list of on|off: the host-RAM KV page "
                          "tier (ISSUE 17; paged only).  Every paged "
@@ -407,9 +433,9 @@ def main(argv=None):
     kv_dtypes = []
     for tok in str(args.kv_dtype).split(","):
         tok = tok.strip().lower()
-        if tok not in ("bf16", "int8"):
-            ap.error("--kv-dtype values must be bf16 or int8, got %r"
-                     % tok)
+        if tok not in ("bf16", "int8", "fp8"):
+            ap.error("--kv-dtype values must be bf16, int8 or fp8, "
+                     "got %r" % tok)
         kv_dtypes.append(tok)
     specs = []
     for tok in str(args.spec).split(","):
@@ -452,35 +478,56 @@ def main(argv=None):
             ap.error("--kv-host values must be on or off, got %r" % tok)
         kv_hosts.append(tok == "on")
 
-    configs = [(paged, kv_dtype, spec, tp, ov, kh)
+    overlap_comms = []
+    for tok in str(args.overlap_comm).split(","):
+        tok = tok.strip().lower()
+        if tok not in ("on", "off"):
+            ap.error("--overlap-comm values must be on or off, got %r"
+                     % tok)
+        overlap_comms.append(tok == "on")
+
+    configs = [(paged, kv_dtype, spec, tp, ov, kh, oc)
                for paged in layouts
                for kv_dtype in kv_dtypes
                for spec in specs
                for tp in tps
                for ov in overlaps
                for kh in kv_hosts
+               for oc in overlap_comms
                # speculation, tensor parallelism and the host KV tier
                # are paged-only
-               if not ((spec or tp > 1 or kh) and not paged)]
+               if not ((spec or tp > 1 or kh) and not paged)
+               # the ring rewrites tp-sharded programs only: an
+               # overlap-comm-on tp=1 line would duplicate the tp=1
+               # series under a different cursor value
+               if not (oc and tp == 1)]
     if not configs:
         # e.g. --slotted --spec 4: silently emitting ZERO lines would
         # make a CI pipe fail later with an opaque empty-stdin error
         ap.error("no runnable configuration: speculative decode "
                  "(--spec > 0), tensor parallelism (--tp > 1) and the "
-                 "host KV tier (--kv-host on) need the paged layout")
-    ab = {}    # (paged, kv, spec, tp, kv_host) -> {overlap: (tokens, gap)}
-    rep = {}   # (paged, kv, spec, tp, overlap) -> {kv_host: repeat_info}
-    for paged, kv_dtype, spec, tp, ov, kh in configs:
+                 "host KV tier (--kv-host on) need the paged layout; "
+                 "--overlap-comm on needs --tp > 1")
+    # (paged, kv, spec, tp, kv_host, oc) -> {overlap: (tokens, gap)}
+    ab = {}
+    # (paged, kv, spec, tp, overlap, oc) -> {kv_host: repeat_info}
+    rep = {}
+    # (paged, kv, spec, tp, overlap, kv_host) -> {oc: tokens}
+    ring_ab = {}
+    for paged, kv_dtype, spec, tp, ov, kh, oc in configs:
         # run_config resets the registry and resyncs the watchdog after
         # its own warmup drain, so no inter-config state scrub is needed
         tokens, gap, repeat = run_config(paged, kv_dtype, spec, tp=tp,
                                          overlap=ov, kv_host=kh,
+                                         overlap_comm=oc,
                                          trace_file=args.trace_file)
-        ab.setdefault((paged, kv_dtype, spec, tp, kh), {})[ov] = \
+        ab.setdefault((paged, kv_dtype, spec, tp, kh, oc), {})[ov] = \
             (tokens, gap)
         if repeat is not None:
-            rep.setdefault((paged, kv_dtype, spec, tp, ov), {})[kh] = \
-                repeat
+            rep.setdefault((paged, kv_dtype, spec, tp, ov, oc),
+                           {})[kh] = repeat
+        ring_ab.setdefault((paged, kv_dtype, spec, tp, ov, kh),
+                           {})[oc] = tokens
     # sync-vs-overlapped A/B (the ISSUE-13 acceptance): when both modes
     # ran one configuration, greedy output must be BIT-IDENTICAL and
     # the overlapped loop's host gap must not exceed the sync loop's
@@ -521,6 +568,28 @@ def main(argv=None):
               "(host tier, %d pages fetched)"
               % (key, off["ttft_ms"], on["ttft_ms"], on["hit_pages"]),
               file=sys.stderr)
+    # ring-vs-monolithic A/B (the ISSUE-20 acceptance): when both
+    # --overlap-comm arms ran one tp=2 configuration, greedy output
+    # must be BIT-IDENTICAL — every partial sum has exactly two f32
+    # terms, so the ring's reduction order equals GSPMD's.  Wider
+    # meshes re-associate the tree reduction (a genuine float
+    # difference, not a bug), so tp>2 pairs report without gating.
+    for key, arms in ring_ab.items():
+        if len(arms) < 2:
+            continue
+        tp = key[3]
+        if arms[False] != arms[True]:
+            if tp == 2:
+                raise SystemExit(
+                    "bench_decode: overlap-comm on-vs-off greedy output "
+                    "DIVERGED for tp=2 config %r — the ring computed a "
+                    "different matmul" % (key,))
+            print("bench_decode: overlap-comm arms differ for tp=%d "
+                  "config %r (reduction re-association — expected past "
+                  "tp=2)" % (tp, key), file=sys.stderr)
+        else:
+            print("bench_decode: overlap-comm A/B ok for %r — greedy "
+                  "bit-identical" % (key,), file=sys.stderr)
 
 
 if __name__ == "__main__":

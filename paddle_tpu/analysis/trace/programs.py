@@ -333,6 +333,63 @@ def _build_serving_tp() -> List[TraceProgram]:
     return out
 
 
+@register_builder("serving_overlap", prefix="serving/")
+def _build_serving_overlap() -> List[TraceProgram]:
+    """The decomposed-collective twins (ISSUE 20): the SAME tp=2 entries
+    as the ``serving_tp`` builder, built with ``overlap_comm=True`` so
+    the monolithic all-gather/all-to-all lowering is replaced by the
+    ppermute rings.  Registering both lets TPU502 confirm the overlap
+    rewrite preserves the donation aliasing and TPU503 audit the
+    partitioned program the overlapped engine actually runs — and the
+    structural zero-monolithic-all-gather test reads these programs'
+    ``collective_stats`` by-kind split."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        raise ProgramSkip(
+            "overlapped tensor-parallel serving programs need >= 2 "
+            "devices; set XLA_FLAGS=--xla_force_host_platform_"
+            "device_count before the backend initializes")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dtype import x64_scope
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.engine import DecodeEngine
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig.tiny())
+    eng = DecodeEngine(model, num_slots=2, max_len=64, page_size=16,
+                       tp=2, spec_k=4, kv_dtype="int8",
+                       overlap_comm=True)
+    mesh_axes = {ax: int(eng.mesh.shape[ax]) for ax in eng.mesh.axis_names}
+    out: List[TraceProgram] = []
+    for name, entry, fn, donate, args in (
+            ("serving/decode_step_tp_overlap", "serving.decode",
+             eng._decode_fn, eng._decode_donate_argnums,
+             eng.decode_trace_args()),
+            ("serving/prefill_chunk_tp_overlap", "serving.prefill_chunk",
+             eng._prefill_chunk_fn, eng._prefill_chunk_donate_argnums,
+             eng.prefill_chunk_trace_args()),
+            ("serving/spec_verify_tp_overlap", "serving.spec_verify",
+             eng._verify_fn, eng._verify_donate_argnums,
+             eng.verify_trace_args())):
+        ins, outs = eng._entry_shardings[entry]
+        audit = jax.jit(fn, donate_argnums=donate, keep_unused=True,
+                        in_shardings=ins, out_shardings=outs)
+        # _entry_scope pins the engine's resolved overlap switch around
+        # the trace exactly as the production retrace path does
+        with x64_scope(False), eng._entry_scope():
+            jaxpr = jax.make_jaxpr(audit)(*args)
+            lowered = audit.lower(*args)
+        out.append(TraceProgram(
+            name=name, jaxpr=jaxpr, lowered_text=lowered.as_text(),
+            lowered=lowered,
+            meta={"kind": "serving", "mesh_axes": mesh_axes,
+                  "spmd_sharded": True, "overlap_comm": True,
+                  "donate_labels": _donate_labels(args)}))
+    return out
+
+
 @register_builder("pallas_kernels", prefix="pallas/")
 def _build_pallas_kernels() -> List[TraceProgram]:
     import jax

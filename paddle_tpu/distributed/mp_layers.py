@@ -52,6 +52,17 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        from . import mp_overlap as _mpo
+        if (not self.gather_output
+                and _mpo.col_viable(self.in_features, self.out_features)):
+            # overlapped column matmul: forward is the same shard-local
+            # program; the custom_vjp backward runs dx's transposed
+            # all-reduce as the ppermute ring (partial-accumulate +
+            # chunked permute).  Off / gather_output ⇒ today's GSPMD
+            # lowering unchanged
+            return call(
+                lambda xr, w, b: _mpo.column_parallel_matmul(xr, w, b),
+                x, self.weight, self.bias, name="mp_overlap_col")
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
             # keep activations sharded on the mp axis (Megatron fused pair)
@@ -84,6 +95,16 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        from . import mp_overlap as _mpo
+        if _mpo.row_viable(self.in_features):
+            # overlapped row matmul: the matmul→all-reduce becomes the
+            # matmul→reduce-scatter ring + ring all-gather, every hop a
+            # ppermute hidden behind the next partial matmul; the
+            # backward is shard-local (Megatron g/f duality).  Off ⇒
+            # today's GSPMD lowering unchanged
+            return call(
+                lambda xr, w, b: _mpo.row_parallel_matmul(xr, w, b),
+                x, self.weight, self.bias, name="mp_overlap_row")
         out = F.linear(x, self.weight, self.bias)
         # GSPMD sees (.., k sharded) @ (k sharded, n) and inserts the psum
         out = with_sharding_constraint(

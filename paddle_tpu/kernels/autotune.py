@@ -534,6 +534,18 @@ def standard_keys() -> List[tuple]:
     out.append(("decode_attn_paged", dat.paged_autotune_key(
         slots=8, pages=128, page_size=64, max_pages=16, h=16, d=64,
         qlen=1, dtype=dtype, tp=2)))
+    # fp8 KV (ISSUE 20) deliberately adds NO standard key: its codes
+    # ride the exact q8 variant structure already registered under the
+    # int8 key (another key would duplicate those pallas programs in
+    # the trace registry), and the bench warms its own key on demand
+    # (autotune_key carries kv_dtype, so the grids can never collide)
+    # decomposed collective-matmul rings (ISSUE 20): the chunk count of
+    # the tp=2 row ring at GPT-2 345M's projection shape — the family
+    # exposes no pallas traceable (it is a shard_map schedule, not a
+    # kernel), so this key is warm()-only and adds no registry programs
+    from ..distributed import mp_overlap as mpo
+    out.append(("mp_overlap", mpo.autotune_key(
+        kind="row", m=8, k=4096 // 2, n=1024, n_dev=2, dtype=dtype)))
     return out
 
 

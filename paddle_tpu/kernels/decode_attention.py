@@ -62,31 +62,56 @@ __all__ = ["decode_attention", "paged_decode_attention", "autotune_key",
 
 _NEG_INF = -1e30
 
-# -- int8 KV grid (the ONE canonical definition — serving.cache imports
-#    these, and the autotune runners synthesize operands through the same
-#    math, so the grid can never drift between the cache's writes and the
-#    kernels' reads; for an fp8/e4m3 pool only _Q_MAX and the code dtype
-#    change) -----------------------------------------------------------
+# -- quantized KV grids (the ONE canonical definition — serving.cache
+#    imports these, and the autotune runners synthesize operands through
+#    the same math, so a grid can never drift between the cache's writes
+#    and the kernels' reads).  ISSUE 20 cashes PR 8's "fp8-ready"
+#    promise: e4m3 shares the whole symmetric-amax pipeline; the grid
+#    constant (448 vs 127) and the code dtype are the only deltas -------
 
-_Q_MAX = 127.0
+_Q_MAX = 127.0          # int8 symmetric grid
+_FP8_MAX = 448.0        # float8_e4m3fn finite max (OCP E4M3: no inf,
+                        # values past ±448 encode NaN — clip, never wrap)
+
+#: kv_dtype key values that select the quantized (codes + scales) paths
+_QUANT_KV_DTYPES = ("int8", "float8_e4m3fn")
 
 
-def quantize_kv(x):
-    """Quantize ``x: (..., heads, head_dim)`` to int8 codes + per-(...,
-    head) f32 scales (symmetric amax/127).  The clip is belt-and-braces:
-    ``|x| <= amax`` bounds ``x/scale`` at 127 up to one f32 rounding."""
+def _grid_for(code_dtype):
+    dt = jnp.dtype(code_dtype)
+    if dt == jnp.dtype(jnp.int8):
+        return dt, _Q_MAX
+    if dt == jnp.dtype(jnp.float8_e4m3fn):
+        return dt, _FP8_MAX
+    raise ValueError("unsupported KV code dtype %r (int8 or "
+                     "float8_e4m3fn)" % (code_dtype,))
+
+
+def quantize_kv(x, code_dtype=jnp.int8):
+    """Quantize ``x: (..., heads, head_dim)`` to codes + per-(..., head)
+    f32 scales (symmetric amax/grid-max).  int8 keeps PR 8's exact math
+    (round then belt-and-braces clip: ``|x| <= amax`` bounds ``x/scale``
+    at 127 up to one f32 rounding).  fp8/e4m3 clips BEFORE the cast —
+    the format saturates to NaN past ±448, so an unclipped one-ulp
+    overshoot would poison the whole attention row — and lets the cast
+    itself do the round-to-nearest-even onto the e4m3 grid."""
+    dt, qmax = _grid_for(code_dtype)
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.maximum(amax, jnp.asarray(1e-30, jnp.float32)) / _Q_MAX
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -_Q_MAX, _Q_MAX)
-    return q.astype(jnp.int8), scale
+    scale = jnp.maximum(amax, jnp.asarray(1e-30, jnp.float32)) / qmax
+    scaled = xf / scale[..., None]
+    if dt == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax)
+    else:
+        q = jnp.clip(scaled, -qmax, qmax)
+    return q.astype(dt), scale
 
 
 def dequantize_kv(codes, scales, dtype):
     """Inverse of :func:`quantize_kv` in the given compute dtype.  The
-    multiply runs f32 (int8->f32 is exact; the single trailing cast to
-    bf16 rounds below the quantization error) — TPU501-clean: no
-    bf16->f32 upcast is involved."""
+    multiply runs f32 (int8->f32 is exact and e4m3->f32 is a widening
+    cast; the single trailing cast to bf16 rounds below the quantization
+    error) — TPU501-clean: no bf16->f32 upcast is involved."""
     return (codes.astype(jnp.float32) * scales[..., None]).astype(dtype)
 
 
@@ -245,7 +270,7 @@ def _chunked_q8(q, k8, ks, v8, vs, pos, scale, block_t):
 
 
 def _candidates(key):
-    if key.get("kv_dtype") == "int8":
+    if key.get("kv_dtype") in _QUANT_KV_DTYPES:
         out = [{"variant": "masked_q8", "config": {}}]
         for bt in supported_block_ts(key["t"]):
             out.append({"variant": "chunked_q8",
@@ -412,7 +437,7 @@ def _paged_chunked_q8(q, kp, ks, vp, vs, table, pos, scale,
 
 
 def _paged_candidates(key):
-    if key.get("kv_dtype") == "int8":
+    if key.get("kv_dtype") in _QUANT_KV_DTYPES:
         out = [{"variant": "paged_gather_q8", "config": {}}]
         for m in supported_pages_per_block(key["max_pages"]):
             out.append({"variant": "paged_chunked_q8",
@@ -479,12 +504,19 @@ _RUNNER_OPERANDS = {}
 
 
 def _is_q8(key):
-    return key.get("kv_dtype") == "int8"
+    """Quantized keys (int8 OR fp8/e4m3 — both route through the same
+    codes+scales variants; the code dtype rides the key)."""
+    return key.get("kv_dtype") in _QUANT_KV_DTYPES
 
 
-# synthetic runner/traceable operands quantize through the SAME grid the
-# serving cache writes with
-_q8_synth = quantize_kv
+def _key_kv_dtype(key):
+    return jnp.dtype(key["kv_dtype"])
+
+
+def _q8_synth(x, code_dtype):
+    # synthetic runner/traceable operands quantize through the SAME grid
+    # the serving cache writes with
+    return quantize_kv(x, code_dtype)
 
 
 def _operands(key):
@@ -506,7 +538,8 @@ def _operands(key):
                    % jnp.asarray(max(t - s, 1), jnp.int32))
             scales = None
             if _is_q8(key):
-                (k, ksc), (v, vsc) = _q8_synth(k), _q8_synth(v)
+                cdt = _key_kv_dtype(key)
+                (k, ksc), (v, vsc) = _q8_synth(k, cdt), _q8_synth(v, cdt)
                 scales = (ksc, vsc)
         ops = _RUNNER_OPERANDS[ks] = (q, k, v, pos, scales)
     return ops
@@ -534,7 +567,7 @@ def _traceable(cand, key):
     dt = jnp.dtype(key["dtype"])
     b, t, h, d, s = (key["slots"], key["t"], key["h"], key["d"],
                      key["qlen"])
-    kv_dt = jnp.int8 if _is_q8(key) else dt
+    kv_dt = _key_kv_dtype(key) if _is_q8(key) else dt
     q = jax.ShapeDtypeStruct((b, s, h, d), dt)
     k = jax.ShapeDtypeStruct((b, t, h, d), kv_dt)
     v = jax.ShapeDtypeStruct((b, t, h, d), kv_dt)
@@ -573,7 +606,9 @@ def _paged_operands(key):
                    % jnp.asarray(max(t - s, 1), jnp.int32))
             scales = None
             if _is_q8(key):
-                (kp, ksc), (vp, vsc) = _q8_synth(kp), _q8_synth(vp)
+                cdt = _key_kv_dtype(key)
+                (kp, ksc), (vp, vsc) = (_q8_synth(kp, cdt),
+                                        _q8_synth(vp, cdt))
                 scales = (ksc, vsc)
         ops = _RUNNER_OPERANDS[ks] = (q, kp, vp, table, pos, scales)
     return ops
@@ -599,7 +634,7 @@ def _paged_traceable(cand, key):
     b, n_pages, P, mp, h, d, s = (
         key["slots"], key["pages"], key["page_size"], key["max_pages"],
         key["h"], key["d"], key["qlen"])
-    kv_dt = jnp.int8 if _is_q8(key) else dt
+    kv_dt = _key_kv_dtype(key) if _is_q8(key) else dt
     q = jax.ShapeDtypeStruct((b, s, h, d), dt)
     kp = jax.ShapeDtypeStruct((n_pages, P, h, d), kv_dt)
     vp = jax.ShapeDtypeStruct((n_pages, P, h, d), kv_dt)

@@ -24,7 +24,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from .. import ops
+from ..core.dispatch import call
 from ..core.tensor import Tensor
+from ..distributed import mp_overlap as _mpo
 from ..distributed.mp_layers import shard_heads, with_sharding_constraint
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -119,20 +121,45 @@ class GPTAttention(Layer):
         self.qkv_proj.bias.pspec = PartitionSpec("mp")
         self.out_proj.weight.pspec = PartitionSpec("mp", None)
 
+    def _out_projection(self, out):
+        # row-sharded projection: overlapped ⇒ the matmul→all-reduce runs
+        # as the ring (partial-accumulate + chunked permute) island; off
+        # ⇒ today's GSPMD lowering through the Linear
+        if _mpo.row_viable(self.hidden_size):
+            return call(
+                lambda o, w, bb: _mpo.row_parallel_matmul(o, w, bb),
+                out, self.out_proj.weight, self.out_proj.bias,
+                name="mp_overlap_row")
+        return self.out_proj(out)
+
     def forward(self, x, cache=None):
         b, s, _ = x.shape
-        qkv = self.qkv_proj(x)
-        # q/k/v as contiguous LAST-DIM slices of the fused projection:
-        # reshape-to-(b,s,3,h,d)+unbind forces a transposed-layout copy of
-        # the whole qkv activation per layer (~0.1 ms × 24 layers × fwd+bwd
-        # on the 345M bench); last-dim slices are free
         h = self.hidden_size
-        q = ops.reshape(qkv[:, :, :h], [b, s, self.num_heads, self.head_dim])
-        k = ops.reshape(qkv[:, :, h:2 * h],
-                        [b, s, self.num_heads, self.head_dim])
-        v = ops.reshape(qkv[:, :, 2 * h:],
-                        [b, s, self.num_heads, self.head_dim])
-        if cache is not None and not isinstance(cache, (tuple, list)):
+        static_cache = (cache is not None
+                        and not isinstance(cache, (tuple, list)))
+        if static_cache and _mpo.qkv_viable(self.num_heads, self.head_dim):
+            # overlapped fused-qkv: column projection + 3-ppermute head
+            # re-deal in one island — replaces GSPMD's per-layer
+            # all-to-all/all-gather reshard from the 3H/tp shard
+            # boundary to the head boundary (PR 11's named follow-up)
+            nh, hd = self.num_heads, self.head_dim
+            q, k, v = call(
+                lambda xr, w, bb: _mpo.qkv_heads(xr, w, bb, nh, hd),
+                x, self.qkv_proj.weight, self.qkv_proj.bias,
+                name="mp_overlap_qkv")
+        else:
+            qkv = self.qkv_proj(x)
+            # q/k/v as contiguous LAST-DIM slices of the fused projection:
+            # reshape-to-(b,s,3,h,d)+unbind forces a transposed-layout copy
+            # of the whole qkv activation per layer (~0.1 ms × 24 layers ×
+            # fwd+bwd on the 345M bench); last-dim slices are free
+            q = ops.reshape(qkv[:, :, :h],
+                            [b, s, self.num_heads, self.head_dim])
+            k = ops.reshape(qkv[:, :, h:2 * h],
+                            [b, s, self.num_heads, self.head_dim])
+            v = ops.reshape(qkv[:, :, 2 * h:],
+                            [b, s, self.num_heads, self.head_dim])
+        if static_cache:
             # static slotted cache (serving.cache view): append into the
             # preallocated buffers + length-masked attention — one shape
             # for the life of the process, no per-token retrace.  Under a
@@ -142,7 +169,7 @@ class GPTAttention(Layer):
             q, k, v = shard_heads(q), shard_heads(k), shard_heads(v)
             out = cache.attend(q, k, v)
             out = ops.reshape(out, [b, s, self.hidden_size])
-            return self.resid_dropout(self.out_proj(out)), cache
+            return self.resid_dropout(self._out_projection(out)), cache
         if cache is not None:
             # LEGACY CONCAT SHIM (see GPTForCausalLM.gen_legacy_concat_cache)
             pk, pv = cache
@@ -156,7 +183,7 @@ class GPTAttention(Layer):
             q, k, v, dropout_p=self.attn_dropout_p, is_causal=True,
             training=self.training)
         out = ops.reshape(out, [b, s, self.hidden_size])
-        out = self.resid_dropout(self.out_proj(out))
+        out = self.resid_dropout(self._out_projection(out))
         if cache is not None:
             return out, cache
         return out
@@ -181,7 +208,16 @@ class GPTMLP(Layer):
         self.fc2.weight.pspec = PartitionSpec("mp", None)
 
     def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        a = F.gelu(self.fc1(x), approximate=True)
+        if _mpo.row_viable(self.fc2.weight.shape[0]):
+            # overlapped row matmul (ring in fwd, shard-local bwd via the
+            # custom_vjp); off ⇒ GSPMD's monolithic all-reduce
+            out = call(
+                lambda o, w, bb: _mpo.row_parallel_matmul(o, w, bb),
+                a, self.fc2.weight, self.fc2.bias, name="mp_overlap_row")
+        else:
+            out = self.fc2(a)
+        return self.dropout(out)
 
 
 class GPTBlock(Layer):
@@ -239,12 +275,19 @@ def _scan_block_apply(x, p, cfg, *, training, keys=None, cache=None):
 
     h = layer_norm_raw(x, p["ln1_w"], p["ln1_b"], (h_sz,),
                        cfg.layer_norm_epsilon)
-    qkv = h @ p["qkv_w"] + p["qkv_b"]
-    # last-dim slices (free) — see GPTAttention.forward for the measured why
-    q = qkv[..., :h_sz].reshape(b, s, nh, hd)
-    k = qkv[..., h_sz:2 * h_sz].reshape(b, s, nh, hd)
-    v = qkv[..., 2 * h_sz:].reshape(b, s, nh, hd)
-    if cache is not None and not isinstance(cache, (tuple, list)):
+    static_cache = (cache is not None
+                    and not isinstance(cache, (tuple, list)))
+    if static_cache and _mpo.qkv_viable(nh, hd):
+        # overlapped fused-qkv island (see GPTAttention.forward)
+        q, k, v = _mpo.qkv_heads(h, p["qkv_w"], p["qkv_b"], nh, hd)
+    else:
+        qkv = h @ p["qkv_w"] + p["qkv_b"]
+        # last-dim slices (free) — see GPTAttention.forward for the
+        # measured why
+        q = qkv[..., :h_sz].reshape(b, s, nh, hd)
+        k = qkv[..., h_sz:2 * h_sz].reshape(b, s, nh, hd)
+        v = qkv[..., 2 * h_sz:].reshape(b, s, nh, hd)
+    if static_cache:
         # static slotted cache view (serving.cache): in-place append +
         # length-masked attention — no shape growth, no retrace.  Head-
         # sharded under a tensor-parallel serving mesh (see
@@ -274,14 +317,20 @@ def _scan_block_apply(x, p, cfg, *, training, keys=None, cache=None):
             if isinstance(out, Tensor):
                 out = out._array
     out = out.reshape(b, s, h_sz)
-    out = out @ p["out_w"] + p["out_b"]
+    if _mpo.row_viable(h_sz):
+        out = _mpo.row_parallel_matmul(out, p["out_w"], p["out_b"])
+    else:
+        out = out @ p["out_w"] + p["out_b"]
     out = dropout(out, cfg.hidden_dropout_prob,
                   None if keys is None else keys[1])
     x = x + out
     h2 = layer_norm_raw(x, p["ln2_w"], p["ln2_b"], (h_sz,),
                         cfg.layer_norm_epsilon)
     m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"], approximate=True)
-    m = m @ p["fc2_w"] + p["fc2_b"]
+    if _mpo.row_viable(cfg.intermediate_size):
+        m = _mpo.row_parallel_matmul(m, p["fc2_w"], p["fc2_b"])
+    else:
+        m = m @ p["fc2_w"] + p["fc2_b"]
     m = dropout(m, cfg.hidden_dropout_prob,
                 None if keys is None else keys[2])
     x = x + m
@@ -542,7 +591,15 @@ class GPTModel(Layer):
                 start = 0 if cache is None else cache[0][0].shape[1]
                 position_ids = ops.arange(start, start + s, dtype="int32")
                 position_ids = ops.unsqueeze(position_ids, 0)
-        x = self.wte(input_ids) + self.wpe(position_ids)
+        if _mpo.embed_viable(self.config.vocab_size):
+            # overlapped vocab-parallel lookup: masked local gather +
+            # psum (activation-sized all-reduce) instead of GSPMD's
+            # table-sized all-gather
+            tok = call(lambda ids, w: _mpo.vocab_embed(ids, w),
+                       input_ids, self.wte.weight, name="mp_overlap_embed")
+            x = tok + self.wpe(position_ids)
+        else:
+            x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         x = with_sharding_constraint(x, PartitionSpec("dp", "sep", None))
         if self.config.scan_layers:
@@ -593,7 +650,17 @@ class GPTForCausalLM(Layer):
         else:
             x = self.gpt(input_ids, position_ids)
         if self.config.tie_word_embeddings:
-            logits = ops.matmul(x, self.gpt.wte.weight, transpose_y=True)
+            if _mpo.lm_viable(self.config.vocab_size):
+                # overlapped LM head: rotate-weights ring over the vocab
+                # shards — each step matmuls the resident shard into its
+                # logits slice while the next is in flight (no monolithic
+                # table all-gather)
+                logits = call(lambda xr, w: _mpo.lm_head_matmul(xr, w),
+                              x, self.gpt.wte.weight,
+                              name="mp_overlap_lm_head")
+            else:
+                logits = ops.matmul(x, self.gpt.wte.weight,
+                                    transpose_y=True)
         else:
             logits = self.lm_head(x)
         if cache is not None:

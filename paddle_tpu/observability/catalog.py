@@ -210,6 +210,16 @@ CATALOG = {
         "histogram", "checkpoint restore (read + verify + deserialize)",
         unit="seconds"),
 
+    # -- tensor-parallel collective-matmul overlap (distributed/mp_overlap —
+    # ISSUE 20) --------------------------------------------------------------
+    "mp.overlap_chunks": _m(
+        "counter", "overlapped collective-matmul islands built at trace "
+        "time, valued at the ring chunk count each resolved (the "
+        "mp_overlap autotune family's knob; single-hop qkv re-deals "
+        "count 1).  Trace-time like compile.count: a compile-once "
+        "program contributes once, so a growing value under steady "
+        "serving is a retrace leak"),
+
     # -- kernels / autotune -------------------------------------------------
     "autotune.cache_hits": _m(
         "counter", "resolve() served from pin/memo/persistent cache"),

@@ -220,18 +220,28 @@ def _hlo_shape_bytes(span: str) -> int:
     return total
 
 
-def collective_stats(compiled) -> Optional[Dict[str, int]]:
-    """``{"ops": N, "bytes": B}`` over the collective instructions of a
-    compiled (post-SPMD-partitioning) executable's optimized HLO, or
-    ``None`` when the backend exposes no HLO text.  ``bytes`` sums each
-    collective's RESULT shape — the data one step moves over the mesh.
-    Async pairs are counted once, at the ``-done`` (whose result is the
-    OUTPUT buffer alone; a ``-start``'s tuple result carries the input
-    buffer and context fields too, which would over-price an async
-    lowering ~1.5x vs the sync form of the same program).  Caveat: this
-    is a STATIC instruction count — a collective inside a while/scan
-    body is priced once, not per trip (the serving decode's per-layer
-    walk is a python loop, so its entries unroll; priced exactly)."""
+def collective_stats(compiled) -> Optional[Dict[str, Any]]:
+    """``{"ops": N, "bytes": B, "by_kind": {...}}`` over the collective
+    instructions of a compiled (post-SPMD-partitioning) executable's
+    optimized HLO, or ``None`` when the backend exposes no HLO text.
+    ``bytes`` sums each collective's RESULT shape — the data one step
+    moves over the mesh.  ``by_kind`` breaks both figures out per HLO
+    op (``{"all-gather": {"ops": n, "bytes": b}, ...}``) — ISSUE 20
+    reads it as a *launches vs bytes* split: a decomposed overlap ring
+    replaces ONE all-gather with ``chunks*(n-1)`` collective-permutes
+    whose summed result bytes stay in the same band, so a raw op-count
+    diff would read the rewrite as an Nx collective regression while
+    the by-kind view shows what actually happened (monolithic kind
+    GONE, permute chain present, bytes ~flat).  Async pairs are counted
+    once, at the ``-done`` (whose result is the OUTPUT buffer alone; a
+    ``-start``'s tuple result carries the input buffer and context
+    fields too, which would over-price an async lowering ~1.5x vs the
+    sync form of the same program).  Caveat: these are STATIC
+    instruction counts — a collective inside a while/scan body is
+    priced once, not per trip (the serving decode's per-layer walk is a
+    python loop, so its entries unroll; priced exactly — but the
+    overlap rings' chunk loops are also fully unrolled at trace time,
+    so every hop of a chunked ring IS a distinct priced instruction)."""
     import re
     try:
         text = compiled.as_text()
@@ -239,26 +249,31 @@ def collective_stats(compiled) -> Optional[Dict[str, int]]:
         return None
     if not isinstance(text, str):
         return None
-    ops = 0
-    total = 0
+    by_kind: Dict[str, Dict[str, int]] = {}
+
+    def _tally(kind, nbytes):
+        slot = by_kind.setdefault(kind, {"ops": 0, "bytes": 0})
+        slot["ops"] += 1
+        slot["bytes"] += nbytes
+
     names = "|".join(_COLLECTIVE_HLO_OPS)
-    head = r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+(?:" + names + r")"
+    head = (r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+(" + names + r")")
     sync_pat = re.compile(head + r"\(")
     done_pat = re.compile(head + r"-done\(")
     start_pat = re.compile(head + r"-start\(")
     for line in text.splitlines():
         m = done_pat.match(line)
         if m:
-            ops += 1
-            total += _hlo_shape_bytes(m.group(1))
+            _tally(m.group(2), _hlo_shape_bytes(m.group(1)))
             continue
         if start_pat.match(line):
             continue    # priced at its -done
         m = sync_pat.match(line)
         if m:
-            ops += 1
-            total += _hlo_shape_bytes(m.group(1))
-    return {"ops": ops, "bytes": total}
+            _tally(m.group(2), _hlo_shape_bytes(m.group(1)))
+    return {"ops": sum(s["ops"] for s in by_kind.values()),
+            "bytes": sum(s["bytes"] for s in by_kind.values()),
+            "by_kind": by_kind}
 
 
 @dataclasses.dataclass
@@ -291,6 +306,11 @@ class ProgramReport:
     #: 0/0 for a genuinely collective-free single-chip program)
     collective_ops: Optional[int] = None
     collective_bytes: Optional[int] = None
+    #: ISSUE 20: the launches-vs-bytes split per HLO collective kind
+    #: (``{"collective-permute": {"ops": n, "bytes": b}, ...}``) — an
+    #: overlap ring trades one big launch for many small ones, which
+    #: only this view can tell apart from a genuine byte regression
+    collective_by_kind: Optional[Dict[str, Dict[str, int]]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -334,6 +354,7 @@ def report_from_compiled(name: str, compiled, backend: Optional[str] = None,
         peak_bytes=_derive_peak(mem),
         collective_ops=(None if coll is None else coll["ops"]),
         collective_bytes=(None if coll is None else coll["bytes"]),
+        collective_by_kind=(None if coll is None else coll["by_kind"]),
     )
 
 

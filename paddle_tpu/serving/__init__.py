@@ -83,8 +83,18 @@ def engine_for(model, num_slots=4, max_len=None, tp=1, **kw):
     the SAME key as before (a kwargs-carried tp would have split them
     into duplicate engines pinning two full KV pools).  ``tp`` engines
     also re-shard the refreshed parameter snapshot onto their mesh
-    (``DecodeEngine.refresh_state``)."""
+    (``DecodeEngine.refresh_state``).
+
+    ``overlap_comm`` is geometry too (an overlapped and a monolithic
+    engine compile different programs), normalized through the same
+    three-level switch the engine resolves (arg > scope >
+    PADDLE_TPU_MP_OVERLAP) so ``overlap_comm=None`` under an enabled env
+    and an explicit ``overlap_comm=True`` share one cached engine."""
+    from ..distributed import mp_overlap as _mpo
     from .engine import DecodeEngine
+    if int(tp) > 1 or "overlap_comm" in kw:
+        kw["overlap_comm"] = bool(
+            _mpo.enabled(kw.get("overlap_comm")) and int(tp) > 1)
     key = (int(num_slots), max_len if max_len is None else int(max_len),
            int(tp), tuple(sorted(kw.items())))
     kw = dict(kw, tp=int(tp))

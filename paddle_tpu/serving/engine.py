@@ -69,6 +69,20 @@ KV bytes/token drop to ``1/tp`` of the single-chip bound
 PER-SHARD truth).  ``tp=1`` (the default) is byte-identical to the
 unsharded engine.
 
+**Decomposed collective overlap (``overlap_comm`` — ISSUE 20).**  On a
+tp>1 engine, ``overlap_comm=True`` (or ``PADDLE_TPU_MP_OVERLAP=1``;
+explicit ``False`` pins it off) traces the sharded entries under
+:mod:`~paddle_tpu.distributed.mp_overlap`'s scope: the per-layer
+monolithic all-gather / all-reduce / all-to-all islands become chunked
+``ppermute`` rings interleaved with the partial matmuls, so on real
+ICI the transfer hides behind compute.  Same math, different schedule
+— at tp=2 every partial sum has exactly two f32 terms and greedy
+output is BIT-identical to the monolithic engine (test-asserted).
+The switch is engine geometry: ``engine_for`` folds the resolved value
+into its cache key, and the structural claim (zero monolithic
+all-gathers, permute chain present) is auditable per-kind via
+``observability.costs.collective_stats``.
+
 **int8 KV cache (``kv_dtype="int8"`` — ISSUE 8).**  Either layout can
 store the pool as int8 codes + per-(row, head) f32 scales
 (:mod:`.cache`): appends quantize in-program, the attention families'
@@ -81,6 +95,11 @@ Opt-in ``PADDLE_TPU_METRICS_KV_QUANT_ERROR=1`` (at engine construction)
 threads a max-abs-dequant-error accumulator through the decode/verify
 entries and publishes the ``serving.kv_quant_error`` gauge (one device
 sync per step, same caveat as ``train.grad_norm``).
+``kv_dtype="fp8"`` (ISSUE 20) runs float8_e4m3fn codes through the
+SAME codes+scales plumbing — identical 1-byte row accounting, an
+amax/448 saturating grid in :func:`.cache.quantize_kv`, and the
+canonical dtype string (``"float8_e4m3fn"``) in the autotune key and
+flight dump.
 
 Every argument that varies across steps (tokens, draft tokens, active
 mask, sampling parameters, PRNG key, page table, lengths) is a traced
@@ -114,10 +133,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..core.dtype import x64_scope
 from ..core.tensor import Tensor
 from ..distributed import mesh as _mesh
+from ..distributed import mp_overlap as _mp_overlap
 from ..distributed.mp_layers import MP_AXIS
 from ..observability import flight as _flight
 from ..observability import registry as _metrics
 from ..observability import tracing as _tracing
+from . import cache as _cache_mod
 from .cache import (DecodeView, PagedDecodeView, PagedKVCache,
                     PagedPrefillChunkView, PrefillView, SlottedKVCache,
                     _unwrap)
@@ -210,7 +231,7 @@ class DecodeEngine:
                  paged=True, page_size=64, num_pages=None,
                  prefill_chunk=None, kv_dtype=None, spec_k=0,
                  spec_ngram=3, tracer=None, tp=1, device=None,
-                 handoff_pages=4, kv_host_bytes=None):
+                 handoff_pages=4, kv_host_bytes=None, overlap_comm=None):
         cfg = model.config
         self.model = model
         # request-scoped tracing (ISSUE 9): the engine lane carries one
@@ -246,12 +267,13 @@ class DecodeEngine:
         self._head_dim = cfg.hidden_size // cfg.num_attention_heads
         self._layers = cfg.num_hidden_layers
         self._cache_dtype = jnp.dtype(cache_dtype)
-        if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
-            raise ValueError("kv_dtype %r unsupported (int8 only; the "
-                             "scale plumbing is fp8-ready)" % (kv_dtype,))
-        self.kv_dtype = (jnp.dtype(jnp.int8) if kv_dtype is not None
+        # canonicalize through the cache's own gate so the engine, the
+        # pool, and the autotune key can never disagree on the code
+        # dtype ("fp8" shorthand included — ISSUE 20)
+        _code_dt, _ = _cache_mod._as_kv_dtypes(kv_dtype)
+        self.kv_dtype = (_code_dt if _code_dt is not None
                          else self._cache_dtype)
-        self._quantized = kv_dtype is not None
+        self._quantized = _code_dt is not None
         # opt-in quant-error gauge: the flag is read ONCE here — it
         # changes the traced entries (an extra carried scalar + output),
         # so toggling the env var mid-process must not retrace
@@ -317,6 +339,13 @@ class DecodeEngine:
             # survive the first call) — role-split disaggregated serving
             # places its prefill engine on its own chip this way
             self.mesh = Mesh(np.asarray([device]), (MP_AXIS,))
+        # -- collective–matmul overlap (ISSUE 20) --------------------------
+        # resolved ONCE at construction (arg > scope > PADDLE_TPU_MP_OVERLAP
+        # env) and pinned into every entry trace via _trace_scope, so the
+        # compiled programs can never flip lowering mid-process.  Only
+        # meaningful on a tp>1 mesh: the rings need a >=2-device 'mp' axis.
+        self.overlap_comm = bool(_mp_overlap.enabled(overlap_comm)
+                                 and self.tp > 1)
         if self.mesh is not None:
             self._param_shard_specs = self._collect_param_specs()
             self.state = self._shard_state(self.state)
@@ -386,7 +415,9 @@ class DecodeEngine:
         _hbm.register_engine(self)
 
     def _kv_dtype_arg(self):
-        return "int8" if self._quantized else None
+        # canonical dtype string ("int8" / "float8_e4m3fn") — the cache
+        # gate and the autotune keys both parse it back via jnp.dtype
+        return str(self.kv_dtype) if self._quantized else None
 
     def _cache_scale_args(self):
         return (self.cache.k_scale, self.cache.v_scale)
@@ -471,8 +502,17 @@ class DecodeEngine:
         declaring 'mp' would otherwise turn the single-chip decode
         trace into an SPMD program over the training devices — the
         'tp=1 is byte-identical to the unsharded engine' contract must
-        hold in mesh-laden processes too."""
-        return _mesh.mesh_scope(self.mesh)
+        hold in mesh-laden processes too.  The overlap switch is pinned
+        the same way: an engine built with overlap_comm=False stays
+        monolithic even if PADDLE_TPU_MP_OVERLAP flips on later (and
+        vice versa) — retraces always reproduce the first lowering."""
+        return self._entry_scope()
+
+    @contextlib.contextmanager
+    def _entry_scope(self):
+        with _mesh.mesh_scope(self.mesh), \
+                _mp_overlap.overlap_scope(self.overlap_comm):
+            yield
 
     def _collective_price(self, entry):
         """Collective bytes ONE step of ``entry`` moves over the mesh,
@@ -1832,7 +1872,8 @@ class DecodeEngine:
         — ``kv_pool_bytes``, ``kv_bytes_per_token``, the HBM ledger —
         inherits per-shard truth from this one place."""
         if self._quantized:
-            per_head = self._head_dim * 1 + 4
+            # 1-byte codes (int8 AND fp8/e4m3) + the f32 scale
+            per_head = self._head_dim * self.kv_dtype.itemsize + 4
         else:
             per_head = self._head_dim * self._cache_dtype.itemsize
         return self._layers * (self._heads // self.tp) * per_head * 2

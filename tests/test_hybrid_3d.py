@@ -67,7 +67,14 @@ import _jax_compat
            "Re-audited again in the ISSUE-18 (flow tier) sweep: the pin "
            "is unchanged (jax 0.4.37, `from jax import shard_map` still "
            "ImportErrors so _OLD_JAX holds) and both failure modes are "
-           "version-determined, so the skip stands verbatim.")
+           "version-determined, so the skip stands verbatim.  "
+           "Re-audited in the ISSUE-20 (mp_overlap) sweep: pin still "
+           "0.4.37 / _OLD_JAX still True, and the new decomposed-ring "
+           "paths deliberately sidestep this class of failure (psums "
+           "are replaced by ppermute accumulation with explicit "
+           "custom_vjp transposes, exercised live in "
+           "tests/test_mp_overlap.py), so the only program still "
+           "hitting the 0.4.37 replication-inference bug is this one.")
 def test_dp_mp_pp_one_program():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

@@ -41,10 +41,10 @@ def test_reference_side_listing_is_covered():
     ref = "/root/reference/python/paddle"
     if not os.path.isdir(ref):
         # environment-conditional, not jax-version (ISSUE-8 skip audit;
-        # re-verified in the ISSUE-18 sweep — /root/reference still
-        # absent here): the reference checkout exists only in the
-        # original graft container; without it this diff has nothing to
-        # diff against.
+        # re-verified in the ISSUE-18 and ISSUE-20 sweeps —
+        # /root/reference still absent here): the reference checkout
+        # exists only in the original graft container; without it this
+        # diff has nothing to diff against.
         # The namespace LIST below still runs unconditionally, and the
         # symbol-parity ratchet (tools/reference_symbols.json +
         # tests/test_symbol_parity.py) gates the surface in every run.

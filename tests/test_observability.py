@@ -939,7 +939,7 @@ def test_bench_schema_rejects_malformed_lines():
 def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
                 metric="decode_tokens_per_sec", layout="paged",
                 kv_dtype=None, spec=None, kv_host=None, repeat_ttft=None,
-                host_hit_pages=None, replicas=None):
+                host_hit_pages=None, replicas=None, overlap_comm=None):
     line = {"metric": metric, "value": value, "unit": "tok/s",
             "cache_layout": layout,
             "compile_counts": {"decode": decode_compiles, "prefill": 1},
@@ -961,6 +961,8 @@ def _traj_entry(tmp_path, name, value, backend, decode_compiles=1,
         line["host_hit_pages"] = host_hit_pages
     if replicas is not None:
         line["replicas"] = replicas
+    if overlap_comm is not None:
+        line["overlap_comm"] = overlap_comm
     p = tmp_path / name
     p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
                              "parsed": line}))
@@ -1119,6 +1121,36 @@ def test_trajectory_kv_host_cursor_and_repeat_ttft_gate(tmp_path):
         bs.validate_line({"metric": "decode_tokens_per_sec",
                           "value": 1.0, "unit": "tok/s",
                           "kv_host": True}, "<line>")
+
+
+def test_trajectory_overlap_comm_cursor_isolation(tmp_path):
+    """ISSUE-20 cursor: the --overlap-comm arms key their own regression
+    cursors (the ring trading launches for hidden transfer paces
+    differently than the monolithic collective — that is the A/B), a
+    real like-for-like drop inside ONE arm still fails, and legacy
+    lines without the field never gate against either arm."""
+    bs = _bench_schema()
+    mixed = [
+        _traj_entry(tmp_path, "BENCH_decode_r71.json", 900.0, "tpu"),
+        _traj_entry(tmp_path, "BENCH_decode_r72.json", 1000.0, "tpu",
+                    overlap_comm="off"),
+        _traj_entry(tmp_path, "BENCH_decode_r73.json", 700.0, "tpu",
+                    overlap_comm="on"),
+        _traj_entry(tmp_path, "BENCH_decode_r74.json", 890.0, "tpu"),
+    ]
+    assert bs.check_trajectory(mixed) == []
+    # the on arm regressing vs ITS last entry fails, anchored past the
+    # off-arm and legacy lines in between
+    mixed.append(_traj_entry(tmp_path, "BENCH_decode_r75.json", 600.0,
+                             "tpu", overlap_comm="on"))
+    fails = bs.check_trajectory(mixed)
+    assert len(fails) == 1 and "regression" in fails[0]
+    assert "BENCH_decode_r75" in fails[0] and "BENCH_decode_r73" in fails[0]
+    # line shape: only the on/off spellings are archivable
+    with pytest.raises(bs.SchemaError, match="overlap_comm"):
+        bs.validate_line({"metric": "decode_tokens_per_sec",
+                          "value": 1.0, "unit": "tok/s",
+                          "overlap_comm": True}, "<line>")
 
 
 def test_trajectory_replicas_cursor_and_fleet_compile_budget(tmp_path):

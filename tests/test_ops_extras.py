@@ -11,8 +11,9 @@ def test_surface_gap_closed():
     import re
     if not os.path.exists("/root/reference/python/paddle/__init__.py"):
         # environment-conditional, not jax-version (ISSUE-8 skip audit;
-        # re-verified in the ISSUE-18 sweep — the reference checkout is
-        # still absent): only the original graft container ships it
+        # re-verified in the ISSUE-18 and ISSUE-20 sweeps — the
+        # reference checkout is still absent): only the original graft
+        # container ships it
         pytest.skip("reference source tree not present in this container "
                     "(the parity ratchet tools/reference_symbols.json + "
                     "tests/test_symbol_parity.py still gates the surface)")

@@ -58,17 +58,18 @@ filename), and these gates run over each series —
   program-cache sizes don't depend on the backend);
 * **on-chip regression**: between CONSECUTIVE entries of one series
   whose ``config.backend == "tpu"`` and whose ``(model, cache_layout,
-  kv_dtype, spec, tp, overlap, kv_host, disagg, qps, mix, replicas)``
-  cursor key matches (the ISSUE-8 A/B matrix interleaves
-  quantized/speculative lines in one trajectory, ISSUE 12 adds the
-  ``--tp`` axis, ISSUE 13 adds the sync-vs-overlapped loop axis plus
-  the serve harness's (QPS, mix) operating points, ISSUE 15 adds the
-  colocated-vs-disaggregated axis, ISSUE 17 adds the ``--kv-host``
-  tier axis, and ISSUE 19 adds the ``--replicas`` fleet axis — a tp=2,
-  sync-loop, disagg, kv-host-on, qps=16, or 2-replica line must never
-  gate against a different series; legacy lines without a field keep
-  their own ``None``-keyed cursor, regression-tested), a >3% drop in
-  ``value`` fails.  CPU entries never perf-gate (smoke numbers), so
+  kv_dtype, spec, tp, overlap, overlap_comm, kv_host, disagg, qps,
+  mix, replicas)`` cursor key matches (the ISSUE-8 A/B matrix
+  interleaves quantized/speculative lines in one trajectory, ISSUE 12
+  adds the ``--tp`` axis, ISSUE 13 adds the sync-vs-overlapped loop
+  axis plus the serve harness's (QPS, mix) operating points, ISSUE 15
+  adds the colocated-vs-disaggregated axis, ISSUE 17 adds the
+  ``--kv-host`` tier axis, ISSUE 19 adds the ``--replicas`` fleet
+  axis, and ISSUE 20 adds the ``--overlap-comm`` decomposed-collective
+  axis — a tp=2, sync-loop, disagg, kv-host-on, qps=16, 2-replica, or
+  overlap-comm-on line must never gate against a different series;
+  legacy lines without a field keep their own ``None``-keyed cursor,
+  regression-tested), a >3% drop in ``value`` fails.  CPU entries never perf-gate (smoke numbers), so
   the gate arms itself automatically the first session that records
   chip numbers;
 * **repeat-prompt TTFT (ISSUE 17)**: over the same like-for-like
@@ -273,6 +274,12 @@ def validate_line(doc: Any, path: str,
         _require(doc["kv_host"] in ("on", "off"), path,
                  "'kv_host' must be 'on' or 'off', got %r"
                  % (doc["kv_host"],))
+    # ISSUE-20 optional field (decomposed collective overlap): absent on
+    # pre-overlap lines (their own legacy cursor), validated when present
+    if "overlap_comm" in doc:
+        _require(doc["overlap_comm"] in ("on", "off"), path,
+                 "'overlap_comm' must be 'on' or 'off', got %r"
+                 % (doc["overlap_comm"],))
     if "repeat_ttft_ms" in doc:
         _require(_is_num(doc["repeat_ttft_ms"])
                  and doc["repeat_ttft_ms"] >= 0, path,
@@ -438,6 +445,10 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
             "spec": line.get("spec"),
             "tp": line.get("tp"),
             "overlap": line.get("overlap"),
+            # ISSUE-20 axis: None on pre-overlap lines keys their own
+            # legacy cursor — an overlapped-ring line never gates
+            # against monolithic-collective history
+            "overlap_comm": line.get("overlap_comm"),
             "kv_host": line.get("kv_host"),
             "disagg": line.get("disagg"),
             "qps": line.get("qps"),
@@ -498,7 +509,8 @@ def check_trajectory(paths: List[str], write: str = None) -> List[str]:
                 continue
             key = (e.get("model"), e.get("cache_layout"),
                    e.get("kv_dtype"), e.get("spec"), e.get("tp"),
-                   e.get("overlap"), e.get("kv_host"), e.get("disagg"),
+                   e.get("overlap"), e.get("overlap_comm"),
+                   e.get("kv_host"), e.get("disagg"),
                    e.get("qps"), e.get("mix"), e.get("replicas"))
             prev = prev_by_key.get(key)
             if (prev is not None and _is_num(e["value"])
